@@ -1,0 +1,15 @@
+"""Test env: force an 8-device virtual CPU mesh before jax import.
+
+Multi-chip hardware is unavailable in CI; all sharding tests run on
+``xla_force_host_platform_device_count=8`` CPU devices, mirroring how the
+driver dry-runs the multi-chip path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,121 @@
+from pathlib import Path
+
+import pytest
+
+from sparknet_tpu.proto.textformat import parse, ParseError
+from sparknet_tpu.proto import caffe_pb
+
+REPO = Path(__file__).resolve().parents[1]
+ZOO = REPO / "sparknet_tpu" / "models" / "prototxt"
+
+
+def test_scalars_and_types():
+    m = parse('name: "net" n: 3 f: 0.5 b: true b2: false e: MAX neg: -2')
+    assert m.get("name") == "net"
+    assert m.get("n") == 3 and isinstance(m.get("n"), int)
+    assert m.get("f") == 0.5
+    assert m.get("b") is True and m.get("b2") is False
+    assert m.get("e") == "MAX"
+    assert m.get("neg") == -2
+
+
+def test_nested_and_repeated():
+    m = parse(
+        """
+        layer { name: "a" bottom: "x" bottom: "y" }
+        layer { name: "b" }
+        """
+    )
+    layers = m.get_all("layer")
+    assert len(layers) == 2
+    assert layers[0].get_all("bottom") == ["x", "y"]
+
+
+def test_colon_brace_and_comments():
+    m = parse('sub: { k: 1 } # trailing comment\n# full line\nv: 2')
+    assert m.get("sub").get("k") == 1
+    assert m.get("v") == 2
+
+
+def test_string_escapes_and_scientific():
+    m = parse(r's: "a\"b" lr: 1e-3')
+    assert m.get("s") == 'a"b'
+    assert m.get("lr") == 1e-3
+
+
+def test_parse_error():
+    with pytest.raises(ParseError):
+        parse("layer { name: ")
+    with pytest.raises(ParseError):
+        parse("} oops")
+
+
+def test_cifar10_quick_net_roundtrip():
+    net = caffe_pb.load_net(str(ZOO / "cifar10_quick_train_test.prototxt"))
+    assert net.name == "CIFAR10_quick"
+    names = [l.name for l in net.layers]
+    assert "conv1" in names and "ip2" in names and "loss" in names
+    conv1 = next(l for l in net.layers if l.name == "conv1")
+    assert conv1.type == "Convolution"
+    assert conv1.convolution_param.get("num_output") == 32
+    assert conv1.convolution_param.get("pad") == 2
+    assert [p.lr_mult for p in conv1.params] == [1.0, 2.0]
+    # phase filtering: two Data layers, one per phase
+    train_layers = net.layers_for_phase("TRAIN")
+    test_layers = net.layers_for_phase("TEST")
+    assert sum(1 for l in train_layers if l.type == "Data") == 1
+    assert any(l.type == "Accuracy" for l in test_layers)
+    assert not any(l.type == "Accuracy" for l in train_layers)
+
+
+def test_cifar10_quick_solver():
+    s = caffe_pb.load_solver(str(ZOO / "cifar10_quick_solver.prototxt"))
+    assert s.base_lr == 0.001
+    assert s.momentum == 0.9
+    assert s.weight_decay == 0.004
+    assert s.lr_policy == "fixed"
+    assert s.max_iter == 4000
+    assert s.net.endswith("cifar10_quick_train_test.prototxt")
+
+
+def test_v1_layer_upgrade():
+    net = caffe_pb.load_net(
+        """
+        name: "v1net"
+        layers { name: "c" type: CONVOLUTION blobs_lr: 1 blobs_lr: 2
+                 convolution_param { num_output: 4 kernel_size: 3 } }
+        layers { name: "r" type: RELU }
+        """,
+        is_path=False,
+    )
+    assert net.layers[0].type == "Convolution"
+    assert net.layers[1].type == "ReLU"
+    assert [p.lr_mult for p in net.layers[0].params] == [1.0, 2.0]
+
+
+def test_last_wins_and_lists_and_concat():
+    m = parse('base_lr: 0.1 base_lr: 0.01')
+    assert m.get("base_lr") == 0.01  # protobuf singular semantics
+    m = parse('stepvalue: [1000, 2000, 3000]')
+    assert m.get_all("stepvalue") == [1000, 2000, 3000]
+    m = parse('s: "a" "b" t: 1')
+    assert m.get("s") == "ab" and m.get("t") == 1
+    m = parse('display: 100# abutting comment\nv: 2')
+    assert m.get("display") == 100 and m.get("v") == 2
+    m = parse('nested: [{ k: 1 }, { k: 2 }]')
+    assert [x.get("k") for x in m.get_all("nested")] == [1, 2]
+    assert parse('b: 1 b: 2 b: 3').to_dict() == {"b": [1, 2, 3]}
+    assert parse('s: "caf\\xc3\\xa9"').get("s") == "caf\xc3\xa9"
+
+
+def test_input_shape_parsing():
+    net = caffe_pb.load_net(
+        """
+        name: "deploy"
+        input: "data"
+        input_dim: 1 input_dim: 3 input_dim: 227 input_dim: 227
+        """,
+        is_path=False,
+    )
+    assert net.inputs == ["data"]
+    assert net.input_shapes == [[1, 3, 227, 227]]
