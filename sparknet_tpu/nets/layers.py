@@ -1,0 +1,940 @@
+"""Caffe layer semantics on XLA — the TPU-native layer library.
+
+The reference executes layers inside native Caffe (SURVEY.md §1-2: Caffe
+vendored as native engine; mount empty so semantics follow the published
+Caffe layer catalogue, not file:line cites). We re-implement the layer
+*contract* — shapes, math, fillers, phase behavior — as pure functions
+on ``jax.numpy``, designed for the TPU:
+
+- **NHWC layout** (channels-last) everywhere, the layout XLA tiles best
+  onto the MXU; Caffe's NCHW axis arguments are remapped (axis 1 ->
+  last). Flatten order therefore differs from Caffe NCHW flatten; this
+  matters only for bit-compat weight import, not for training parity.
+- Convolution weights are stored **HWIO**, matmul weights **(in, out)**
+  — both directly MXU-friendly, no transposes in the hot path.
+- All shape arithmetic (ceil-mode pooling, Caffe's average-pool divisor
+  that counts padding) is precomputed with numpy at trace time, so the
+  compiled graph contains only static-shaped ``lax`` ops.
+
+Each layer type registers three pure functions:
+``infer`` (shape inference), ``init`` (param fillers), ``apply``.
+BatchNorm additionally carries running stats through the ``state``
+pytree (Caffe keeps them in blobs; a functional state pytree is the JAX
+equivalent).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..proto.caffe_pb import Filler, LayerParameter
+
+Shape = Tuple[int, ...]
+
+# Layer types that declare net inputs rather than computing anything.
+DATA_LAYER_TYPES = {
+    "Data",
+    "Input",
+    "MemoryData",
+    "ImageData",
+    "HDF5Data",
+    "DummyData",
+    "AnnotatedData",
+    "WindowData",
+}
+
+LOSS_LAYER_TYPES = {
+    "SoftmaxWithLoss",
+    "SigmoidCrossEntropyLoss",
+    "EuclideanLoss",
+    "HingeLoss",
+}
+
+
+@dataclass
+class ApplyCtx:
+    train: bool
+    rng: Optional[jax.Array]
+    compute_dtype: Any = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _ints(param, name: str, default: int, count: int = 2) -> Tuple[int, ...]:
+    """Caffe repeated-or-scalar spatial params (kernel_size/stride/pad)."""
+    if param is None:
+        return (default,) * count
+    vals = [int(v) for v in param.get_all(name)]
+    h = param.get(name + "_h")
+    w = param.get(name + "_w")
+    if h is not None or w is not None:
+        return (int(h if h is not None else default), int(w if w is not None else default))
+    if not vals:
+        return (default,) * count
+    if len(vals) == 1:
+        return (vals[0],) * count
+    return tuple(vals[:count])
+
+
+def caffe_axis(axis: int, ndim: int) -> int:
+    """Map a Caffe NCHW-axis argument onto our NHWC layout."""
+    if axis < 0:
+        axis += ndim
+    if ndim == 4:
+        return {0: 0, 1: 3, 2: 1, 3: 2}[axis]
+    return axis
+
+
+def fill(filler: Filler, rng: jax.Array, shape: Shape, fan_in: int, fan_out: int) -> jax.Array:
+    t = filler.type
+    if t == "constant":
+        return jnp.full(shape, filler.value, jnp.float32)
+    if t == "gaussian":
+        return filler.mean + filler.std * jax.random.normal(rng, shape, jnp.float32)
+    if t == "uniform":
+        return jax.random.uniform(rng, shape, jnp.float32, filler.min, filler.max)
+    if t in ("xavier", "msra"):
+        if filler.variance_norm == "FAN_OUT":
+            fan = fan_out
+        elif filler.variance_norm == "AVERAGE":
+            fan = (fan_in + fan_out) / 2.0
+        else:
+            fan = fan_in
+        if t == "xavier":
+            scale = math.sqrt(3.0 / fan)
+            return jax.random.uniform(rng, shape, jnp.float32, -scale, scale)
+        std = math.sqrt(2.0 / fan)
+        return std * jax.random.normal(rng, shape, jnp.float32)
+    if t == "bilinear":
+        # upsampling deconv init; rarely used — approximate with msra
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(rng, shape, jnp.float32)
+    raise NotImplementedError(f"filler type {t!r}")
+
+
+def _conv_geom(lp: LayerParameter):
+    p = lp.convolution_param
+    if p is None:
+        raise ValueError(f"layer {lp.name}: missing convolution_param")
+    kh, kw = _ints(p, "kernel_size", 0)
+    sh, sw = _ints(p, "stride", 1)
+    ph, pw = _ints(p, "pad", 0)
+    dh, dw = _ints(p, "dilation", 1)
+    group = int(p.get("group", 1))
+    cout = int(p.get("num_output"))
+    bias = bool(p.get("bias_term", True))
+    return (kh, kw), (sh, sw), (ph, pw), (dh, dw), group, cout, bias
+
+
+def _conv_out(h: int, k: int, s: int, p: int, d: int) -> int:
+    keff = d * (k - 1) + 1
+    return (h + 2 * p - keff) // s + 1
+
+
+def _pool_out(h: int, k: int, s: int, p: int) -> int:
+    """Caffe ceil-mode pooling output size with the start-inside clamp."""
+    out = int(math.ceil((h + 2 * p - k) / s)) + 1
+    if p > 0 and (out - 1) * s >= h + p:
+        out -= 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer implementations. Each is a namespace of pure functions.
+
+
+class Convolution:
+    @staticmethod
+    def infer(lp: LayerParameter, in_shapes: List[Shape]) -> List[Shape]:
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), group, cout, _ = _conv_geom(lp)
+        n, h, w, c = in_shapes[0]
+        return [(n, _conv_out(h, kh, sh, ph, dh), _conv_out(w, kw, sw, pw, dw), cout)]
+
+    @staticmethod
+    def init(lp: LayerParameter, rng: jax.Array, in_shapes: List[Shape]) -> Dict[str, jax.Array]:
+        (kh, kw), _, _, _, group, cout, bias = _conv_geom(lp)
+        cin = in_shapes[0][3]
+        assert cin % group == 0 and cout % group == 0, (
+            f"{lp.name}: group={group} must divide cin={cin}, cout={cout}"
+        )
+        p = lp.convolution_param
+        wf = Filler.from_message(p.get("weight_filler"))
+        k1, k2 = jax.random.split(rng)
+        fan_in = kh * kw * (cin // group)
+        fan_out = kh * kw * (cout // group)
+        params = {"weight": fill(wf, k1, (kh, kw, cin // group, cout), fan_in, fan_out)}
+        if bias:
+            bf = Filler.from_message(p.get("bias_filler"))
+            params["bias"] = fill(bf, k2, (cout,), fan_in, fan_out)
+        return params
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx: ApplyCtx):
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), group, cout, bias = _conv_geom(lp)
+        x = inputs[0].astype(ctx.compute_dtype)
+        w = params["weight"].astype(ctx.compute_dtype)
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=group,
+            preferred_element_type=jnp.float32,
+        )
+        if bias and "bias" in params:
+            y = y + params["bias"]
+        return [y], None
+
+
+class Deconvolution:
+    @staticmethod
+    def infer(lp, in_shapes):
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), group, cout, _ = _conv_geom(lp)
+        n, h, w, c = in_shapes[0]
+        oh = sh * (h - 1) + (dh * (kh - 1) + 1) - 2 * ph
+        ow = sw * (w - 1) + (dw * (kw - 1) + 1) - 2 * pw
+        return [(n, oh, ow, cout)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return Convolution.init(lp, rng, in_shapes)
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        # Transposed conv as an lhs-dilated conv (supports groups, which
+        # lax.conv_transpose does not expose): dilate the input by the
+        # stride, spatially flip the kernel, pad by keff-1-p.
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw), group, cout, bias = _conv_geom(lp)
+        x = inputs[0].astype(ctx.compute_dtype)
+        w = params["weight"].astype(ctx.compute_dtype)
+        w = jnp.flip(w, (0, 1))
+        keff_h = dh * (kh - 1) + 1
+        keff_w = dw * (kw - 1) + 1
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(1, 1),
+            padding=((keff_h - 1 - ph, keff_h - 1 - ph), (keff_w - 1 - pw, keff_w - 1 - pw)),
+            lhs_dilation=(sh, sw),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=group,
+            preferred_element_type=jnp.float32,
+        )
+        if bias and "bias" in params:
+            y = y + params["bias"]
+        return [y], None
+
+
+class Pooling:
+    @staticmethod
+    def _geom(lp, in_shape):
+        p = lp.pooling_param
+        n, h, w, c = in_shape
+        if p is not None and bool(p.get("global_pooling", False)):
+            kh, kw = h, w
+            sh = sw = 1
+            ph = pw = 0
+        else:
+            kh, kw = _ints(p, "kernel_size", 0)
+            sh, sw = _ints(p, "stride", 1)
+            ph, pw = _ints(p, "pad", 0)
+        mode = str(p.get("pool", "MAX")) if p is not None else "MAX"
+        return (kh, kw), (sh, sw), (ph, pw), mode
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        (kh, kw), (sh, sw), (ph, pw), _ = Pooling._geom(lp, in_shapes[0])
+        n, h, w, c = in_shapes[0]
+        return [(n, _pool_out(h, kh, sh, ph), _pool_out(w, kw, sw, pw), c)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        n, h, w, c = x.shape
+        (kh, kw), (sh, sw), (ph, pw), mode = Pooling._geom(lp, x.shape)
+        oh = _pool_out(h, kh, sh, ph)
+        ow = _pool_out(w, kw, sw, pw)
+        # ceil mode may need extra low-side... no: extra high-side padding
+        extra_h = max(0, (oh - 1) * sh + kh - (h + 2 * ph))
+        extra_w = max(0, (ow - 1) * sw + kw - (w + 2 * pw))
+        pad_h = (ph, ph + extra_h)
+        pad_w = (pw, pw + extra_w)
+        if mode == "MAX":
+            y = lax.reduce_window(
+                x,
+                -jnp.inf,
+                lax.max,
+                (1, kh, kw, 1),
+                (1, sh, sw, 1),
+                ((0, 0), pad_h, pad_w, (0, 0)),
+            )
+            return [y.astype(x.dtype)], None
+        if mode == "AVE":
+            s = lax.reduce_window(
+                x.astype(jnp.float32),
+                0.0,
+                lax.add,
+                (1, kh, kw, 1),
+                (1, sh, sw, 1),
+                ((0, 0), pad_h, pad_w, (0, 0)),
+            )
+            # Caffe divisor: window clipped to the *padded* region — padding
+            # counts toward the denominator. Static per-position constant.
+            hs = np.arange(oh) * sh - ph
+            he = np.minimum(hs + kh, h + ph)
+            hs = np.maximum(hs, -ph)
+            ws_ = np.arange(ow) * sw - pw
+            we = np.minimum(ws_ + kw, w + pw)
+            ws_ = np.maximum(ws_, -pw)
+            div = (he - hs)[:, None] * (we - ws_)[None, :]
+            y = s / jnp.asarray(div[None, :, :, None], jnp.float32)
+            return [y.astype(x.dtype)], None
+        raise NotImplementedError(f"pool mode {mode}")
+
+
+class InnerProduct:
+    @staticmethod
+    def _geom(lp):
+        p = lp.inner_product_param
+        return int(p.get("num_output")), bool(p.get("bias_term", True)), int(p.get("axis", 1))
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        cout, _, axis = InnerProduct._geom(lp)
+        if axis != 1:
+            raise NotImplementedError(
+                f"layer {lp.name!r}: inner_product axis={axis} unsupported (only 1)"
+            )
+        return [(in_shapes[0][0], cout)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        cout, bias, axis = InnerProduct._geom(lp)
+        cin = int(np.prod(in_shapes[0][1:]))
+        p = lp.inner_product_param
+        wf = Filler.from_message(p.get("weight_filler"))
+        bf = Filler.from_message(p.get("bias_filler"))
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": fill(wf, k1, (cin, cout), cin, cout)}
+        if bias:
+            params["bias"] = fill(bf, k2, (cout,), cin, cout)
+        return params
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        cout, bias, axis = InnerProduct._geom(lp)
+        x = inputs[0]
+        x2 = x.reshape(x.shape[0], -1).astype(ctx.compute_dtype)
+        w = params["weight"].astype(ctx.compute_dtype)
+        y = jnp.dot(x2, w, preferred_element_type=jnp.float32)
+        if bias and "bias" in params:
+            y = y + params["bias"]
+        return [y], None
+
+
+class ReLU:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        p = lp.sub("relu_param")
+        slope = float(p.get("negative_slope", 0.0)) if p else 0.0
+        if slope:
+            return [jnp.where(x > 0, x, slope * x)], None
+        return [jax.nn.relu(x)], None
+
+
+class _Elementwise:
+    fn = staticmethod(lambda x: x)
+
+    @classmethod
+    def infer(cls, lp, in_shapes):
+        return [in_shapes[0]]
+
+    @classmethod
+    def init(cls, lp, rng, in_shapes):
+        return {}
+
+    @classmethod
+    def apply(cls, lp, params, state, inputs, ctx):
+        return [cls.fn(inputs[0])], None
+
+
+class Sigmoid(_Elementwise):
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+class TanH(_Elementwise):
+    fn = staticmethod(jnp.tanh)
+
+
+class AbsVal(_Elementwise):
+    fn = staticmethod(jnp.abs)
+
+
+class BNLL(_Elementwise):
+    # log(1 + exp(x)), computed stably
+    fn = staticmethod(jax.nn.softplus)
+
+
+class ELU:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        p = lp.sub("elu_param")
+        alpha = float(p.get("alpha", 1.0)) if p else 1.0
+        return [jax.nn.elu(inputs[0], alpha)], None
+
+
+class Power:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        p = lp.sub("power_param")
+        power = float(p.get("power", 1.0)) if p else 1.0
+        scale = float(p.get("scale", 1.0)) if p else 1.0
+        shift = float(p.get("shift", 0.0)) if p else 0.0
+        y = scale * inputs[0] + shift
+        if power != 1.0:
+            y = jnp.power(y, power)
+        return [y], None
+
+
+class Exp:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        p = lp.sub("exp_param")
+        base = float(p.get("base", -1.0)) if p else -1.0
+        scale = float(p.get("scale", 1.0)) if p else 1.0
+        shift = float(p.get("shift", 0.0)) if p else 0.0
+        y = scale * inputs[0] + shift
+        return [jnp.exp(y) if base <= 0 else jnp.power(base, y)], None
+
+
+class Log:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        p = lp.sub("log_param")
+        base = float(p.get("base", -1.0)) if p else -1.0
+        scale = float(p.get("scale", 1.0)) if p else 1.0
+        shift = float(p.get("shift", 0.0)) if p else 0.0
+        y = jnp.log(scale * inputs[0] + shift)
+        if base > 0:
+            y = y / math.log(base)
+        return [y], None
+
+
+class LRN:
+    """Local response normalization (AlexNet/GoogLeNet). ACROSS_CHANNELS
+    runs the window over the channel axis — last in NHWC, so the rolling
+    sum is a reduce_window over a minor axis, which XLA vectorizes well.
+    """
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.lrn_param
+        size = int(p.get("local_size", 5)) if p else 5
+        alpha = float(p.get("alpha", 1.0)) if p else 1.0
+        beta = float(p.get("beta", 0.75)) if p else 0.75
+        k = float(p.get("k", 1.0)) if p else 1.0
+        region = str(p.get("norm_region", "ACROSS_CHANNELS")) if p else "ACROSS_CHANNELS"
+        return size, alpha, beta, k, region
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        size, alpha, beta, k, region = LRN._geom(lp)
+        x = inputs[0]
+        sq = jnp.square(x.astype(jnp.float32))
+        half = size // 2
+        if region == "ACROSS_CHANNELS":
+            window = (1, 1, 1, size)
+            padding = ((0, 0), (0, 0), (0, 0), (half, size - 1 - half))
+            scale = alpha / size
+        else:  # WITHIN_CHANNEL: avg over the size*size spatial window, k fixed 1
+            window = (1, size, size, 1)
+            padding = ((0, 0), (half, size - 1 - half), (half, size - 1 - half), (0, 0))
+            scale = alpha / (size * size)
+            k = 1.0
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), padding)
+        denom = jnp.power(k + scale * ssum, beta)
+        return [(x / denom).astype(x.dtype)], None
+
+
+class Dropout:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        p = lp.dropout_param
+        ratio = float(p.get("dropout_ratio", 0.5)) if p else 0.5
+        if not ctx.train or ratio <= 0.0:
+            return [x], None
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)], None
+
+
+class BatchNorm:
+    """Caffe BatchNorm: normalization only (pair with Scale for affine).
+
+    Caffe stores unnormalized sums + a scale factor in blobs; we keep
+    normalized running mean/var in the state pytree with EMA updates
+    (equivalent fixed point; SURVEY.md notes no file:line available).
+    """
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.batch_norm_param
+        use_global = p.get("use_global_stats") if p else None
+        mavf = float(p.get("moving_average_fraction", 0.999)) if p else 0.999
+        eps = float(p.get("eps", 1e-5)) if p else 1e-5
+        return use_global, mavf, eps
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def init_state(lp, in_shapes):
+        c = in_shapes[0][-1]
+        return {
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        use_global, mavf, eps = BatchNorm._geom(lp)
+        x = inputs[0]
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))  # all but channel
+        if use_global is None:
+            use_global = not ctx.train
+        if use_global:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        else:
+            mean = jnp.mean(xf, axes)
+            var = jnp.var(xf, axes)
+            new_state = {
+                "mean": mavf * state["mean"] + (1 - mavf) * mean,
+                "var": mavf * state["var"] + (1 - mavf) * var,
+            }
+        y = (xf - mean) * lax.rsqrt(var + eps)
+        return [y.astype(x.dtype)], new_state
+
+
+class Scale:
+    """Per-channel (axis) scale, optional bias: the affine half of BN."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        p = lp.scale_param
+        bias = bool(p.get("bias_term", False)) if p else False
+        c = in_shapes[0][-1]
+        wf = Filler.from_message(p.get("filler")) if p and p.get("filler") else Filler(type="constant", value=1.0)
+        bf = Filler.from_message(p.get("bias_filler")) if p and p.get("bias_filler") else Filler(type="constant", value=0.0)
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": fill(wf, k1, (c,), c, c)}
+        if bias:
+            params["bias"] = fill(bf, k2, (c,), c, c)
+        return params
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        if len(inputs) == 2:  # two-bottom form: second input is the scale
+            y = inputs[0] * inputs[1]
+        else:
+            y = inputs[0] * params["weight"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return [y], None
+
+
+class Bias:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        if len(in_shapes) == 2:
+            return {}
+        c = in_shapes[0][-1]
+        return {"bias": jnp.zeros((c,), jnp.float32)}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        b = inputs[1] if len(inputs) == 2 else params["bias"]
+        return [inputs[0] + b], None
+
+
+class Eltwise:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        p = lp.eltwise_param
+        op = str(p.get("operation", "SUM")) if p else "SUM"
+        if op == "SUM":
+            coeffs = [float(c) for c in p.get_all("coeff")] if p else []
+            if coeffs:
+                y = sum(c * x for c, x in zip(coeffs, inputs))
+            else:
+                y = sum(inputs[1:], inputs[0])
+        elif op == "PROD":
+            y = inputs[0]
+            for x in inputs[1:]:
+                y = y * x
+        elif op == "MAX":
+            y = inputs[0]
+            for x in inputs[1:]:
+                y = jnp.maximum(y, x)
+        else:
+            raise NotImplementedError(f"eltwise op {op}")
+        return [y], None
+
+
+class Concat:
+    @staticmethod
+    def _axis(lp, ndim):
+        p = lp.concat_param
+        ax = int(p.get("axis", p.get("concat_dim", 1))) if p else 1
+        return caffe_axis(ax, ndim)
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        ax = Concat._axis(lp, len(in_shapes[0]))
+        out = list(in_shapes[0])
+        out[ax] = sum(s[ax] for s in in_shapes)
+        return [tuple(out)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        return [jnp.concatenate(inputs, Concat._axis(lp, inputs[0].ndim))], None
+
+
+class Slice:
+    @staticmethod
+    def _geom(lp, in_shape):
+        p = lp.sub("slice_param")
+        ax = int(p.get("axis", p.get("slice_dim", 1))) if p else 1
+        ax = caffe_axis(ax, len(in_shape))
+        points = [int(x) for x in p.get_all("slice_point")] if p else []
+        return ax, points
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        ax, points = Slice._geom(lp, in_shapes[0])
+        total = in_shapes[0][ax]
+        if not points:
+            n = len(lp.top)
+            points = [total // n * i for i in range(1, n)]
+        bounds = [0] + points + [total]
+        outs = []
+        for i in range(len(bounds) - 1):
+            s = list(in_shapes[0])
+            s[ax] = bounds[i + 1] - bounds[i]
+            outs.append(tuple(s))
+        return outs
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        ax, points = Slice._geom(lp, x.shape)
+        if not points:
+            n = len(lp.top)
+            points = [x.shape[ax] // n * i for i in range(1, n)]
+        return list(jnp.split(x, points, axis=ax)), None
+
+
+class Split:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]] * max(1, len(lp.top))
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        return [inputs[0]] * max(1, len(lp.top)), None
+
+
+class Flatten:
+    @staticmethod
+    def infer(lp, in_shapes):
+        s = in_shapes[0]
+        return [(s[0], int(np.prod(s[1:])))]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        return [x.reshape(x.shape[0], -1)], None
+
+
+class Reshape:
+    @staticmethod
+    def _shape(lp, in_shape):
+        p = lp.sub("reshape_param")
+        dims = [int(d) for d in p.get("shape").get_all("dim")]
+        out = []
+        for i, d in enumerate(dims):
+            if d == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(d)
+        # resolve a single -1
+        if -1 in out:
+            known = int(np.prod([d for d in out if d != -1]))
+            total = int(np.prod(in_shape))
+            out[out.index(-1)] = total // known
+        return tuple(out)
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [Reshape._shape(lp, in_shapes[0])]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        return [inputs[0].reshape(Reshape._shape(lp, inputs[0].shape))], None
+
+
+class Softmax:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        p = lp.sub("softmax_param")
+        ax = caffe_axis(int(p.get("axis", 1)) if p else 1, x.ndim)
+        return [jax.nn.softmax(x.astype(jnp.float32), axis=ax).astype(x.dtype)], None
+
+
+class SoftmaxWithLoss:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        logits, labels = inputs[0], inputs[1]
+        logits = logits.astype(jnp.float32)
+        if logits.ndim > 2:
+            ax = caffe_axis(1, logits.ndim)
+            logits = jnp.moveaxis(logits, ax, -1).reshape(-1, logits.shape[ax])
+            labels = labels.reshape(-1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[:, None], axis=-1
+        )[:, 0]
+        p = lp.sub("loss_param")
+        ignore = p.get("ignore_label") if p else None
+        if ignore is not None:
+            valid = labels != int(ignore)
+            loss = jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(
+                jnp.sum(valid), 1
+            )
+        else:
+            loss = jnp.mean(nll)
+        return [loss], None
+
+
+class SigmoidCrossEntropyLoss:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x, t = inputs[0].astype(jnp.float32), inputs[1].astype(jnp.float32)
+        # stable: max(x,0) - x*t + log(1+exp(-|x|)); Caffe normalizes by N
+        loss = jnp.sum(
+            jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        ) / x.shape[0]
+        return [loss], None
+
+
+class EuclideanLoss:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        a, b = inputs[0].astype(jnp.float32), inputs[1].astype(jnp.float32)
+        return [jnp.sum(jnp.square(a - b)) / (2.0 * a.shape[0])], None
+
+
+class Accuracy:
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()] * max(1, len(lp.top))
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        logits, labels = inputs[0], inputs[1].astype(jnp.int32)
+        p = lp.sub("accuracy_param")
+        top_k = int(p.get("top_k", 1)) if p else 1
+        if top_k == 1:
+            correct = jnp.argmax(logits, -1) == labels
+        else:
+            _, idx = lax.top_k(logits, top_k)
+            correct = jnp.any(idx == labels[:, None], axis=-1)
+        acc = jnp.mean(correct.astype(jnp.float32))
+        outs = [acc] * max(1, len(lp.top))
+        return outs, None
+
+
+LAYER_IMPLS = {
+    "Convolution": Convolution,
+    "Deconvolution": Deconvolution,
+    "Pooling": Pooling,
+    "InnerProduct": InnerProduct,
+    "ReLU": ReLU,
+    "Sigmoid": Sigmoid,
+    "TanH": TanH,
+    "AbsVal": AbsVal,
+    "BNLL": BNLL,
+    "ELU": ELU,
+    "Power": Power,
+    "Exp": Exp,
+    "Log": Log,
+    "LRN": LRN,
+    "Dropout": Dropout,
+    "BatchNorm": BatchNorm,
+    "Scale": Scale,
+    "Bias": Bias,
+    "Eltwise": Eltwise,
+    "Concat": Concat,
+    "Slice": Slice,
+    "Split": Split,
+    "Flatten": Flatten,
+    "Reshape": Reshape,
+    "Softmax": Softmax,
+    "SoftmaxWithLoss": SoftmaxWithLoss,
+    "SigmoidCrossEntropyLoss": SigmoidCrossEntropyLoss,
+    "EuclideanLoss": EuclideanLoss,
+    "Accuracy": Accuracy,
+}

@@ -1,0 +1,199 @@
+"""Caffe solver semantics as a pure JAX update function.
+
+The reference drives training through native ``caffe::Solver::Step``
+(SURVEY.md §3 call stack; mount empty, no file:line). We reproduce the
+solver *math* — SGD/Nesterov/Adam/AdaGrad/RMSProp/AdaDelta, the lr
+policy zoo, per-blob ``lr_mult``/``decay_mult``, L2/L1 regularisation,
+global-norm gradient clipping, ``iter_size`` accumulation — as a
+``(params, grads, opt_state, iter) -> (params, opt_state)`` pure
+function. The iteration counter lives *inside* jit (an int32 array), so
+the LR schedule compiles to branchless XLA via ``jnp.where`` over the
+policy's closed form; no per-step recompilation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import SolverParameter
+
+
+def learning_rate(sp: SolverParameter, it: jax.Array) -> jax.Array:
+    """Caffe lr_policy closed forms, traceable in ``it``."""
+    itf = it.astype(jnp.float32)
+    p = sp.lr_policy
+    if p == "fixed":
+        lr = jnp.asarray(sp.base_lr, jnp.float32)
+    elif p == "step":
+        lr = sp.base_lr * jnp.power(sp.gamma, jnp.floor(itf / sp.stepsize))
+    elif p == "exp":
+        lr = sp.base_lr * jnp.power(sp.gamma, itf)
+    elif p == "inv":
+        lr = sp.base_lr * jnp.power(1.0 + sp.gamma * itf, -sp.power)
+    elif p == "multistep":
+        steps = jnp.asarray(sp.stepvalue or [jnp.iinfo(jnp.int32).max], jnp.int32)
+        current = jnp.sum((it >= steps).astype(jnp.float32))
+        lr = sp.base_lr * jnp.power(sp.gamma, current)
+    elif p == "poly":
+        frac = jnp.clip(itf / max(sp.max_iter, 1), 0.0, 1.0)
+        lr = sp.base_lr * jnp.power(1.0 - frac, sp.power)
+    elif p == "sigmoid":
+        lr = sp.base_lr / (1.0 + jnp.exp(-sp.gamma * (itf - sp.stepsize)))
+    else:
+        raise NotImplementedError(f"lr_policy {p!r}")
+    if sp.warmup_iter > 0:
+        warm = (itf + 1.0) / float(sp.warmup_iter)
+        lr = jnp.where(it < sp.warmup_iter, lr * warm, lr)
+    return lr
+
+
+def init_opt_state(sp: SolverParameter, params: Any) -> Dict[str, Any]:
+    zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+    t = sp.solver_type.upper()
+    if t in ("SGD", "NESTEROV"):
+        return {"momentum": zeros()}
+    if t == "ADAM":
+        return {"m": zeros(), "v": zeros()}
+    if t == "ADAGRAD":
+        return {"h": zeros()}
+    if t == "RMSPROP":
+        return {"h": zeros()}
+    if t == "ADADELTA":
+        return {"h": zeros(), "d": zeros()}
+    raise NotImplementedError(f"solver type {sp.solver_type!r}")
+
+
+def _regularize(sp: SolverParameter, g, w, decay_mult: float):
+    local_decay = sp.weight_decay * decay_mult
+    if local_decay == 0.0:
+        return g
+    if sp.regularization_type == "L1":
+        return g + local_decay * jnp.sign(w)
+    return g + local_decay * w
+
+
+def make_update_fn(
+    sp: SolverParameter,
+    lr_mults: Optional[Any] = None,
+    decay_mults: Optional[Any] = None,
+):
+    """Build ``update(params, grads, opt_state, it) -> (params, opt_state)``.
+
+    ``lr_mults``/``decay_mults`` are pytrees of floats matching ``params``
+    (from ``XLANet.param_specs``); None means all-ones.
+    """
+    t = sp.solver_type.upper()
+
+    def update(params, grads, opt_state, it):
+        rate = learning_rate(sp, it)
+        if sp.clip_gradients > 0:
+            gnorm = jnp.sqrt(
+                sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+            )
+            scale = jnp.minimum(1.0, sp.clip_gradients / jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+        lm = lr_mults if lr_mults is not None else jax.tree_util.tree_map(lambda _: 1.0, params)
+        dm = decay_mults if decay_mults is not None else jax.tree_util.tree_map(lambda _: 1.0, params)
+
+        if t == "SGD":
+            def upd(w, g, v, l, d):
+                g = _regularize(sp, g, w, d)
+                v2 = sp.momentum * v + rate * l * g
+                return w - v2, v2
+
+            out = jax.tree_util.tree_map(upd, params, grads, opt_state["momentum"], lm, dm)
+            new_p = jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+            new_v = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+            return new_p, {"momentum": new_v}
+
+        if t == "NESTEROV":
+            def upd(w, g, v, l, d):
+                g = _regularize(sp, g, w, d)
+                v2 = sp.momentum * v + rate * l * g
+                return w - ((1 + sp.momentum) * v2 - sp.momentum * v), v2
+
+            out = jax.tree_util.tree_map(upd, params, grads, opt_state["momentum"], lm, dm)
+            new_p = jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+            new_v = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+            return new_p, {"momentum": new_v}
+
+        if t == "ADAM":
+            step = it.astype(jnp.float32) + 1.0
+            b1, b2 = sp.momentum, sp.momentum2
+            corr = jnp.sqrt(1.0 - jnp.power(b2, step)) / (1.0 - jnp.power(b1, step))
+
+            def upd(w, g, m, v, l, d):
+                g = _regularize(sp, g, w, d)
+                m2 = b1 * m + (1 - b1) * g
+                v2 = b2 * v + (1 - b2) * jnp.square(g)
+                return w - rate * l * corr * m2 / (jnp.sqrt(v2) + sp.delta), m2, v2
+
+            out = jax.tree_util.tree_map(
+                upd, params, grads, opt_state["m"], opt_state["v"], lm, dm
+            )
+            pick = lambda i: jax.tree_util.tree_map(
+                lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
+            )
+            return pick(0), {"m": pick(1), "v": pick(2)}
+
+        if t == "ADAGRAD":
+            def upd(w, g, h, l, d):
+                g = _regularize(sp, g, w, d)
+                h2 = h + jnp.square(g)
+                return w - rate * l * g / (jnp.sqrt(h2) + sp.delta), h2
+
+            out = jax.tree_util.tree_map(upd, params, grads, opt_state["h"], lm, dm)
+            pick = lambda i: jax.tree_util.tree_map(
+                lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
+            )
+            return pick(0), {"h": pick(1)}
+
+        if t == "RMSPROP":
+            def upd(w, g, h, l, d):
+                g = _regularize(sp, g, w, d)
+                h2 = sp.rms_decay * h + (1 - sp.rms_decay) * jnp.square(g)
+                return w - rate * l * g / (jnp.sqrt(h2) + sp.delta), h2
+
+            out = jax.tree_util.tree_map(upd, params, grads, opt_state["h"], lm, dm)
+            pick = lambda i: jax.tree_util.tree_map(
+                lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
+            )
+            return pick(0), {"h": pick(1)}
+
+        if t == "ADADELTA":
+            def upd(w, g, h, dacc, l, d):
+                g = _regularize(sp, g, w, d)
+                h2 = sp.momentum * h + (1 - sp.momentum) * jnp.square(g)
+                step = g * jnp.sqrt(dacc + sp.delta) / jnp.sqrt(h2 + sp.delta)
+                d2 = sp.momentum * dacc + (1 - sp.momentum) * jnp.square(step)
+                return w - rate * l * step, h2, d2
+
+            out = jax.tree_util.tree_map(
+                upd, params, grads, opt_state["h"], opt_state["d"], lm, dm
+            )
+            pick = lambda i: jax.tree_util.tree_map(
+                lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
+            )
+            return pick(0), {"h": pick(1), "d": pick(2)}
+
+        raise NotImplementedError(f"solver type {sp.solver_type!r}")
+
+    return update
+
+
+def mults_for_params(params, specs) -> Tuple[Any, Any]:
+    """Shape (lr_mults, decay_mults) pytrees like ``params`` from
+    ``XLANet.param_specs()`` output."""
+    lr = {
+        layer: {name: specs.get(layer, {}).get(name, (1.0, 1.0))[0] for name in ps}
+        for layer, ps in params.items()
+    }
+    dec = {
+        layer: {name: specs.get(layer, {}).get(name, (1.0, 1.0))[1] for name in ps}
+        for layer, ps in params.items()
+    }
+    return lr, dec

@@ -1,0 +1,162 @@
+"""Solver: the training loop, behaviorally Caffe's ``Solver::Step``.
+
+The reference's executor-side loop is ``CaffeNet.train(tau)`` -> native
+``Solver::Step(tau)`` (SURVEY.md §3; mount empty). Here the whole
+iteration — forward, backward, regularise, update, LR schedule — is a
+single jitted function with donated buffers, so stepping ``tau`` times
+is ``tau`` XLA executions with zero host round-trips in between (the
+reference pays a JNI weight copy per sync; we pay nothing until the
+caller explicitly materialises metrics).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..proto import caffe_pb
+from ..nets.xlanet import XLANet
+from .caffe_solver import init_opt_state, make_update_fn, mults_for_params
+
+
+def make_grad_fn(net: XLANet) -> Callable:
+    """``grad_fn(params, state, batch, rng) -> (grads, new_state, metrics)``."""
+
+    def grad_fn(params, state, batch, rng):
+        def loss_fn(p):
+            blobs, new_state = net.apply(p, state, batch, train=True, rng=rng)
+            loss, metrics = net.loss_and_metrics(blobs)
+            return loss, (new_state, metrics)
+
+        grads, (new_state, metrics) = jax.grad(loss_fn, has_aux=True)(params)
+        return grads, new_state, metrics
+
+    return grad_fn
+
+
+def make_train_step(net: XLANet, sp: caffe_pb.SolverParameter) -> Callable:
+    """Returns jittable
+    ``train_step(params, state, opt_state, batch, it, rng)
+       -> (params, state, opt_state, metrics)``.
+
+    ``batch`` may carry a leading micro-batch axis of size
+    ``sp.iter_size``: Caffe's gradient accumulation is then a
+    ``lax.scan`` over micro-batches inside the same XLA program.
+    """
+    grad_fn = make_grad_fn(net)
+
+    def train_step(params, state, opt_state, batch, it, rng):
+        if sp.iter_size > 1:
+            def body(carry, micro):
+                st, i = carry
+                g, st2, m = grad_fn(params, st, micro, jax.random.fold_in(rng, i))
+                return (st2, i + 1), (g, m)
+
+            (new_state, _), (gstack, mstack) = jax.lax.scan(
+                body, (state, 0), batch
+            )
+            grads = jax.tree_util.tree_map(lambda g: jnp.mean(g, 0), gstack)
+            metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, 0), mstack)
+        else:
+            grads, new_state, metrics = grad_fn(params, state, batch, rng)
+        specs = net.param_specs()
+        lr_m, dec_m = mults_for_params(params, specs)
+        update = make_update_fn(sp, lr_m, dec_m)
+        params, opt_state = update(params, grads, opt_state, it)
+        return params, new_state, opt_state, metrics
+
+    return train_step
+
+
+def make_eval_step(net: XLANet) -> Callable:
+    def eval_step(params, state, batch):
+        blobs, _ = net.apply(params, state, batch, train=False, rng=None)
+        _, metrics = net.loss_and_metrics(blobs)
+        return metrics
+
+    return eval_step
+
+
+class Solver:
+    """Owns params/state/opt_state and drives jitted steps.
+
+    ``batch_fn`` supplies training batches (dict blob->array);
+    ``test_batch_fn`` likewise for the TEST phase net.
+    """
+
+    def __init__(
+        self,
+        solver: caffe_pb.SolverParameter,
+        input_shapes: Dict[str, Tuple[int, ...]],
+        test_input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+        net_param: Optional[caffe_pb.NetParameter] = None,
+        solver_dir: str = ".",
+        compute_dtype: Any = jnp.float32,
+        seed: int = 0,
+    ):
+        self.sp = solver
+        if net_param is None:
+            if solver.net_param is not None:
+                net_param = solver.net_param
+            else:
+                net_path = solver.net or solver.train_net
+                if net_path is None:
+                    raise ValueError(
+                        "solver specifies no net (no net/train_net path, no "
+                        "inline net_param, and none passed to Solver)"
+                    )
+                if not os.path.exists(net_path):
+                    net_path = os.path.join(solver_dir, net_path)
+                net_param = caffe_pb.load_net(net_path)
+        self.net_param = net_param
+        self.train_net = XLANet(net_param, "TRAIN", input_shapes, compute_dtype)
+        self.test_net = XLANet(
+            net_param, "TEST", test_input_shapes or input_shapes, compute_dtype
+        )
+        seed = solver.random_seed if solver.random_seed >= 0 else seed
+        self.rng = jax.random.PRNGKey(seed)
+        self.rng, init_rng = jax.random.split(self.rng)
+        self.params, self.state = self.train_net.init(init_rng)
+        self.opt_state = init_opt_state(solver, self.params)
+        self.iter = 0
+        self._train_step = jax.jit(
+            make_train_step(self.train_net, solver), donate_argnums=(0, 1, 2)
+        )
+        self._eval_step = jax.jit(make_eval_step(self.test_net))
+
+    def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
+        """Run ``n`` iterations (the reference's ``Solver::Step(n)``)."""
+        metrics = {}
+        for _ in range(n):
+            if self.sp.iter_size > 1:
+                micro = [next(batches) for _ in range(self.sp.iter_size)]
+                batch = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *micro
+                )
+            else:
+                batch = next(batches)
+            self.rng, step_rng = jax.random.split(self.rng)
+            self.params, self.state, self.opt_state, metrics = self._train_step(
+                self.params,
+                self.state,
+                self.opt_state,
+                batch,
+                jnp.asarray(self.iter, jnp.int32),
+                step_rng,
+            )
+            self.iter += 1
+            if log_fn and self.sp.display and self.iter % self.sp.display == 0:
+                log_fn(self.iter, {k: float(v) for k, v in metrics.items()})
+        return metrics
+
+    def test(self, batches: Iterator[Dict[str, Any]], test_iter: Optional[int] = None):
+        n = test_iter or (self.sp.test_iter[0] if self.sp.test_iter else 1)
+        acc: Dict[str, float] = {}
+        for _ in range(n):
+            m = self._eval_step(self.params, self.state, next(batches))
+            for k, v in m.items():
+                acc[k] = acc.get(k, 0.0) + float(v)
+        return {k: v / n for k, v in acc.items()}

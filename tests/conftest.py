@@ -1,15 +1,21 @@
-"""Test env: force an 8-device virtual CPU mesh before jax import.
+"""Test env: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is unavailable in CI; all sharding tests run on
 ``xla_force_host_platform_device_count=8`` CPU devices, mirroring how the
-driver dry-runs the multi-chip path.
+driver dry-runs the multi-chip path. Note: this environment pins
+``JAX_PLATFORMS=axon`` (the TPU tunnel) and re-asserts it over the env
+var, so we must force CPU through ``jax.config`` — the env var alone is
+not honored.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,207 @@
+"""Layer numerics vs torch-CPU oracle (NCHW<->NHWC adapted)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.proto.caffe_pb import LayerParameter
+from sparknet_tpu.proto.textformat import parse
+from sparknet_tpu.nets import layers as L
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F
+
+
+def lp_from(text: str) -> LayerParameter:
+    return LayerParameter.from_message(parse(text))
+
+
+def nhwc(x_nchw: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1)))
+
+
+def to_nchw(y: jnp.ndarray) -> np.ndarray:
+    return np.transpose(np.asarray(y), (0, 3, 1, 2))
+
+
+CTX = L.ApplyCtx(train=False, rng=None)
+
+
+@pytest.mark.parametrize(
+    "cin,cout,k,s,p,d,g",
+    [
+        (3, 8, 3, 1, 1, 1, 1),
+        (4, 6, 5, 2, 2, 1, 2),
+        (3, 8, 3, 1, 2, 2, 1),
+        (8, 8, 1, 1, 0, 1, 8),  # depthwise
+    ],
+)
+def test_convolution_vs_torch(cin, cout, k, s, p, d, g):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, cin, 13, 11)).astype(np.float32)
+    w = rng.normal(size=(cout, cin // g, k, k)).astype(np.float32)
+    b = rng.normal(size=(cout,)).astype(np.float32)
+
+    lp = lp_from(
+        f'name: "c" type: "Convolution" convolution_param {{ '
+        f"num_output: {cout} kernel_size: {k} stride: {s} pad: {p} "
+        f"dilation: {d} group: {g} }}"
+    )
+    params = {"weight": jnp.asarray(np.transpose(w, (2, 3, 1, 0))), "bias": jnp.asarray(b)}
+    (y,), _ = L.Convolution.apply(lp, params, None, [nhwc(x)], CTX)
+    ref = F.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+        stride=s, padding=p, dilation=d, groups=g,
+    ).numpy()
+    np.testing.assert_allclose(to_nchw(y), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_max_pool_ceil_mode_vs_torch():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 4, 11, 11)).astype(np.float32)
+    lp = lp_from('name: "p" type: "Pooling" pooling_param { pool: MAX kernel_size: 3 stride: 2 }')
+    (y,), _ = L.Pooling.apply(lp, {}, None, [nhwc(x)], CTX)
+    ref = F.max_pool2d(torch.from_numpy(x), 3, 2, 0, ceil_mode=True).numpy()
+    assert to_nchw(y).shape == ref.shape
+    np.testing.assert_allclose(to_nchw(y), ref, rtol=1e-6)
+
+
+def test_ave_pool_caffe_divisor():
+    # Caffe AVE pooling: window clipped to padded region; divisor counts
+    # padding. Construct the reference directly.
+    rng = np.random.default_rng(2)
+    H = W = 5
+    k, s, p = 3, 2, 1
+    x = rng.normal(size=(1, 1, H, W)).astype(np.float32)
+    lp = lp_from(
+        'name: "p" type: "Pooling" pooling_param { pool: AVE kernel_size: 3 stride: 2 pad: 1 }'
+    )
+    (y,), _ = L.Pooling.apply(lp, {}, None, [nhwc(x)], CTX)
+    y = to_nchw(y)[0, 0]
+
+    oh = L._pool_out(H, k, s, p)
+    ref = np.zeros((oh, oh), np.float32)
+    for i in range(oh):
+        for j in range(oh):
+            hs, ws = i * s - p, j * s - p
+            he, we = min(hs + k, H + p), min(ws + k, W + p)
+            pool_size = (he - hs) * (we - ws)
+            hs0, ws0 = max(hs, 0), max(ws, 0)
+            he0, we0 = min(he, H), min(we, W)
+            ref[i, j] = x[0, 0, hs0:he0, ws0:we0].sum() / pool_size
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_across_channels_vs_torch():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 8, 6, 6)).astype(np.float32)
+    size, alpha, beta, k = 5, 1e-4, 0.75, 1.0
+    lp = lp_from(
+        f'name: "n" type: "LRN" lrn_param {{ local_size: {size} alpha: {alpha} beta: {beta} }}'
+    )
+    (y,), _ = L.LRN.apply(lp, {}, None, [nhwc(x)], CTX)
+    ref = torch.nn.LocalResponseNorm(size, alpha, beta, k)(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(to_nchw(y), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_with_loss_vs_torch():
+    rng = np.random.default_rng(4)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 16)
+    lp = lp_from('name: "l" type: "SoftmaxWithLoss"')
+    (loss,), _ = L.SoftmaxWithLoss.apply(
+        lp, {}, None, [jnp.asarray(logits), jnp.asarray(labels)], CTX
+    )
+    ref = F.cross_entropy(torch.from_numpy(logits), torch.from_numpy(labels)).item()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-6)
+
+
+def test_inner_product_and_accuracy():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 7)).astype(np.float32)
+    w = rng.normal(size=(7, 3)).astype(np.float32)
+    lp = lp_from('name: "ip" type: "InnerProduct" inner_product_param { num_output: 3 }')
+    (y,), _ = L.InnerProduct.apply(lp, {"weight": jnp.asarray(w)}, None, [jnp.asarray(x)], CTX)
+    # bias_term defaults true but params lack bias -> apply() must honor param presence
+    np.testing.assert_allclose(np.asarray(y), x @ w, rtol=1e-5)
+
+    logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+    labels = np.array([1, 0, 0])
+    alp = lp_from('name: "a" type: "Accuracy" top: "accuracy"')
+    (acc,), _ = L.Accuracy.apply(alp, {}, None, [jnp.asarray(logits), jnp.asarray(labels)], CTX)
+    np.testing.assert_allclose(float(acc), 2.0 / 3.0, rtol=1e-6)
+
+
+def test_batchnorm_train_then_eval():
+    rng = np.random.default_rng(6)
+    x = rng.normal(loc=3.0, scale=2.0, size=(8, 5, 5, 4)).astype(np.float32)
+    lp = lp_from('name: "bn" type: "BatchNorm" batch_norm_param { moving_average_fraction: 0.0 }')
+    state = L.BatchNorm.init_state(lp, [x.shape])
+    ctx_tr = L.ApplyCtx(train=True, rng=None)
+    (y,), new_state = L.BatchNorm.apply(lp, {}, state, [jnp.asarray(x)], ctx_tr)
+    # normalized output: per-channel mean ~0, var ~1
+    np.testing.assert_allclose(np.asarray(y).mean((0, 1, 2)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).var((0, 1, 2)), 1.0, atol=1e-3)
+    # mavf=0 -> running stats equal batch stats; eval reproduces train output
+    (y2,), _ = L.BatchNorm.apply(lp, {}, new_state, [jnp.asarray(x)], CTX)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y), rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_train_eval():
+    x = jnp.ones((1000,))
+    lp = lp_from('name: "d" type: "Dropout" dropout_param { dropout_ratio: 0.4 }')
+    (y_eval,), _ = L.Dropout.apply(lp, {}, None, [x], CTX)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    ctx = L.ApplyCtx(train=True, rng=jax.random.PRNGKey(0))
+    (y_tr,), _ = L.Dropout.apply(lp, {}, None, [x], ctx)
+    y_tr = np.asarray(y_tr)
+    assert abs((y_tr == 0).mean() - 0.4) < 0.06  # drop rate
+    nz = y_tr[y_tr != 0]
+    np.testing.assert_allclose(nz, 1.0 / 0.6, rtol=1e-5)  # inverted scaling
+
+
+def test_eltwise_concat_slice():
+    a = jnp.asarray(np.arange(12, dtype=np.float32).reshape(1, 1, 2, 6))
+    b = a + 1
+    lp = lp_from('name: "e" type: "Eltwise" eltwise_param { operation: SUM coeff: 1 coeff: -1 }')
+    (y,), _ = L.Eltwise.apply(lp, {}, None, [a, b], CTX)
+    np.testing.assert_allclose(np.asarray(y), -1.0)
+
+    lp = lp_from('name: "c" type: "Concat"')  # default caffe axis 1 -> NHWC last
+    (y,), _ = L.Concat.apply(lp, {}, None, [a, b], CTX)
+    assert y.shape == (1, 1, 2, 12)
+
+    lp = lp_from('name: "s" type: "Slice" top: "x" top: "y" slice_param { slice_point: 4 }')
+    outs, _ = L.Slice.apply(lp, {}, None, [a], CTX)
+    assert outs[0].shape == (1, 1, 2, 4) and outs[1].shape == (1, 1, 2, 2)
+
+
+def test_grouped_deconvolution_shape_and_upsample():
+    # FCN-style grouped upsampling must trace and double spatial dims
+    lp = lp_from(
+        'name: "up" type: "Deconvolution" convolution_param { '
+        "num_output: 6 group: 6 kernel_size: 4 stride: 2 pad: 1 bias_term: false "
+        'weight_filler { type: "constant" value: 0.25 } }'
+    )
+    x = jnp.ones((1, 5, 5, 6))
+    [out_shape] = L.Deconvolution.infer(lp, [x.shape])
+    params = L.Deconvolution.init(lp, jax.random.PRNGKey(0), [x.shape])
+    (y,), _ = L.Deconvolution.apply(lp, params, None, [x], CTX)
+    assert y.shape == out_shape == (1, 10, 10, 6)
+
+
+def test_lrn_within_channel_scale():
+    # constant input: denom = (1 + alpha/size^2 * sum(window))^beta with
+    # full interior windows -> y = x / (1 + alpha*x^2)^beta
+    size, alpha, beta = 3, 2.0, 0.75
+    lp = lp_from(
+        f'name: "n" type: "LRN" lrn_param {{ local_size: {size} alpha: {alpha} '
+        f"beta: {beta} norm_region: WITHIN_CHANNEL k: 5.0 }}"
+    )
+    x = 2.0 * jnp.ones((1, 7, 7, 1))
+    (y,), _ = L.LRN.apply(lp, {}, None, [x], CTX)
+    interior = np.asarray(y)[0, 3, 3, 0]
+    expected = 2.0 / (1.0 + alpha * 4.0) ** beta  # k ignored within-channel
+    np.testing.assert_allclose(interior, expected, rtol=1e-6)
