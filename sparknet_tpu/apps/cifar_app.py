@@ -1,0 +1,174 @@
+"""CifarApp — CIFAR-10 end-to-end training entrypoint.
+
+Behavioral twin of the reference's ``CifarApp`` (SURVEY.md §2; launched
+via spark-submit there, via ``python -m sparknet_tpu.apps.cifar_app``
+here): reads a Caffe solver prototxt, loads CIFAR-10 (binary/pickle
+layouts, or a deterministic synthetic set with ``--synthetic``), applies
+the net's ``transform_param`` preprocessing, trains with test-interval
+evaluation and snapshotting, and prints Caffe-style progress lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Dict, Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.cifar import cifar10_dataset
+from ..data.preprocess import Transformer
+from ..nets import weights as W
+from ..proto import caffe_pb
+from ..solver.trainer import Solver
+
+
+def _data_layer(net: caffe_pb.NetParameter, phase: str):
+    for l in net.layers_for_phase(phase):
+        if l.type in ("Data", "Input", "MemoryData", "ImageData"):
+            return l
+    return None
+
+
+def _batch_size(layer, default: int) -> int:
+    for field in ("data_param", "memory_data_param", "image_data_param"):
+        sub = layer.sub(field) if layer else None
+        if sub is not None and sub.get("batch_size") is not None:
+            return int(sub.get("batch_size"))
+    return default
+
+
+def make_feed(
+    ds, transformer: Transformer, batch_size: int, seed: int = 0
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    def transform(batch, rng):
+        return {
+            "data": jnp.asarray(transformer(batch["data"], rng)),
+            "label": jnp.asarray(batch["label"], jnp.int32),
+        }
+
+    return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
+
+
+def build(args) -> tuple:
+    sp = caffe_pb.load_solver(args.solver)
+    solver_dir = os.path.dirname(os.path.abspath(args.solver))
+    if args.max_iter:
+        sp.max_iter = args.max_iter
+
+    net_path = sp.net or sp.train_net
+    if net_path and not os.path.exists(net_path):
+        net_path = os.path.join(solver_dir, os.path.basename(net_path))
+    net_param = caffe_pb.load_net(net_path) if net_path else sp.net_param
+
+    train_layer = _data_layer(net_param, "TRAIN")
+    test_layer = _data_layer(net_param, "TEST")
+    train_bs = args.batch_size or _batch_size(train_layer, 100)
+    test_bs = _batch_size(test_layer, train_bs)
+
+    data_dir = None if args.synthetic else args.data_dir
+    train_ds, mean = cifar10_dataset(data_dir, train=True, synthetic_n=args.synthetic_n)
+    test_ds, _ = cifar10_dataset(data_dir, train=False, synthetic_n=args.synthetic_n)
+
+    def transformer_for(layer, train: bool) -> Transformer:
+        t = Transformer.from_message(
+            layer.transform_param if layer else None, train=train
+        )
+        # mean_file in the prototxt -> per-pixel mean computed from data
+        tp = layer.transform_param if layer else None
+        if tp is not None and tp.get("mean_file") is not None:
+            t.mean_image = mean
+        return t
+
+    train_tf = transformer_for(train_layer, True)
+    test_tf = transformer_for(test_layer, False)
+
+    crop = train_tf.crop_size or 32
+    shapes = {"data": (train_bs, crop, crop, 3), "label": (train_bs,)}
+    test_crop = test_tf.crop_size or 32
+    test_shapes = {"data": (test_bs, test_crop, test_crop, 3), "label": (test_bs,)}
+
+    solver = Solver(
+        sp,
+        shapes,
+        test_input_shapes=test_shapes,
+        net_param=net_param,
+        solver_dir=solver_dir,
+        seed=args.seed,
+    )
+    train_feed = make_feed(train_ds, train_tf, train_bs, seed=args.seed)
+    test_feed = make_feed(test_ds, test_tf, test_bs, seed=args.seed + 1)
+    return solver, train_feed, test_feed
+
+
+def train_loop(solver: Solver, train_feed, test_feed, log=print) -> Dict[str, float]:
+    sp = solver.sp
+    t0 = time.time()
+    last_test: Dict[str, float] = {}
+    while solver.iter < sp.max_iter:
+        # stop at the nearest of: next test boundary, next snapshot
+        # boundary, max_iter — so neither cadence can skip the other's.
+        targets = [sp.max_iter]
+        for interval in (sp.test_interval, sp.snapshot):
+            if interval:
+                targets.append((solver.iter // interval + 1) * interval)
+        nxt = min(targets)
+        solver.step(
+            train_feed,
+            nxt - solver.iter,
+            log_fn=lambda it, m: log(
+                f"Iteration {it}, loss = {m.get('loss', float('nan')):.5f}"
+            ),
+        )
+        at_end = solver.iter >= sp.max_iter
+        if (sp.test_interval and solver.iter % sp.test_interval == 0) or at_end:
+            last_test = solver.test(test_feed)
+            for k, v in last_test.items():
+                log(f"    Test net output: {k} = {v:.4f}")
+        if (
+            sp.snapshot
+            and sp.snapshot_prefix
+            and (solver.iter % sp.snapshot == 0 or at_end)
+        ):
+            path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
+            W.save_npz(path, solver.params)
+            log(f"Snapshotting to {path}")
+    dt = time.time() - t0
+    log(
+        f"Optimization Done. {sp.max_iter} iters in {dt:.1f}s "
+        f"({sp.max_iter / max(dt, 1e-9):.1f} it/s)"
+    )
+    return last_test
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="CIFAR-10 training (CifarApp)")
+    ap.add_argument(
+        "--solver",
+        default=os.path.join(
+            os.path.dirname(__file__), "..", "models", "prototxt",
+            "cifar10_quick_solver.prototxt",
+        ),
+    )
+    ap.add_argument("--data-dir", default=os.environ.get("CIFAR10_DIR"))
+    ap.add_argument("--synthetic", action="store_true",
+                    help="use the deterministic synthetic dataset")
+    ap.add_argument("--synthetic-n", type=int, default=10000)
+    ap.add_argument("--max-iter", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    solver, train_feed, test_feed = build(args)
+    print(
+        f"CifarApp: net={solver.net_param.name} params="
+        f"{W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
+    )
+    result = train_loop(solver, train_feed, test_feed)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""CIFAR-10 loader: Caffe-style binary batches, python pickles, or
+deterministic synthetic data when no dataset is on disk.
+
+The reference's CifarApp loads the CIFAR-10 binary distribution into an
+RDD (SURVEY.md §2 data loaders; mount empty). Binary record format:
+1 label byte + 3072 bytes (3x32x32, CHW planar). We emit NHWC uint8.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .rdd import ShardedDataset
+
+NUM_CLASSES = 10
+IMAGE_SHAPE = (32, 32, 3)
+PER_PIXEL_MEAN_KEY = "cifar10_mean"
+
+
+def _decode_binary(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    rec = np.frombuffer(raw, np.uint8).reshape(-1, 3073)
+    labels = rec[:, 0].astype(np.int32)
+    images = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # -> NHWC
+    return images, labels
+
+
+def _decode_pickle(d: Dict) -> Tuple[np.ndarray, np.ndarray]:
+    images = (
+        np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    )
+    labels = np.asarray(d[b"labels"], np.int32)
+    return images, labels
+
+
+def load_cifar10(
+    data_dir: str, train: bool = True
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Find CIFAR-10 in ``data_dir`` in any common layout; None if absent."""
+    names = (
+        [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    )
+    # caffe binary layout
+    bins = [os.path.join(data_dir, n + ".bin") for n in names]
+    if all(os.path.exists(b) for b in bins):
+        ims, lbs = zip(*[_decode_binary(open(b, "rb").read()) for b in bins])
+        return np.concatenate(ims), np.concatenate(lbs)
+    # python pickle layout
+    pkls = [os.path.join(data_dir, n) for n in names]
+    sub = os.path.join(data_dir, "cifar-10-batches-py")
+    if os.path.isdir(sub):
+        pkls = [os.path.join(sub, n) for n in names]
+    if all(os.path.exists(p) for p in pkls):
+        ims, lbs = zip(
+            *[
+                _decode_pickle(pickle.load(open(p, "rb"), encoding="bytes"))
+                for p in pkls
+            ]
+        )
+        return np.concatenate(ims), np.concatenate(lbs)
+    # tarball
+    tar = os.path.join(data_dir, "cifar-10-python.tar.gz")
+    if os.path.exists(tar):
+        ims, lbs = [], []
+        with tarfile.open(tar) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    i, l = _decode_pickle(d)
+                    ims.append(i)
+                    lbs.append(l)
+        if ims:
+            return np.concatenate(ims), np.concatenate(lbs)
+    return None
+
+
+def synthetic_cifar10(
+    n: int = 10000, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in: class-dependent colored quadrant
+    blobs + noise. Lets the full pipeline (and benchmarks) run with no
+    dataset on disk."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, NUM_CLASSES, n).astype(np.int32)
+    images = rng.integers(0, 60, (n, 32, 32, 3)).astype(np.uint8)
+    for cls in range(NUM_CLASSES):
+        sel = labels == cls
+        r, c = divmod(cls, 4)
+        patch = np.zeros((32, 32, 3), np.uint8)
+        patch[8 * r : 8 * r + 12, 8 * c : 8 * c + 12, cls % 3] = 180
+        images[sel] = np.minimum(255 - images[sel], images[sel] + patch)
+    return images, labels
+
+
+def cifar10_dataset(
+    data_dir: Optional[str],
+    train: bool = True,
+    num_partitions: int = 8,
+    synthetic_n: int = 10000,
+) -> Tuple[ShardedDataset, np.ndarray]:
+    """Returns (dataset of {"data": uint8 NHWC, "label": int32}, per-pixel
+    mean image for transform_param mean subtraction)."""
+    loaded = load_cifar10(data_dir, train) if data_dir else None
+    if loaded is None:
+        loaded = synthetic_cifar10(synthetic_n if train else synthetic_n // 5,
+                                   seed=0 if train else 1)
+    images, labels = loaded
+    mean = images.astype(np.float32).mean(0)
+    ds = ShardedDataset.from_arrays(
+        {"data": images, "label": labels}, num_partitions
+    )
+    return ds, mean

@@ -1,0 +1,155 @@
+"""ShardedDataset — the reference's RDD role, TPU-native.
+
+The reference keeps training data in Spark RDDs: partitioned across
+executors, recomputable from lineage on failure, iterated per-partition
+by the trainer (SURVEY.md §1-2 — broadcast + ``RDD.mapPartitions(train)``;
+mount empty, no file:line). The TPU-native equivalent keeps the two
+properties that actually matter — *deterministic sharding* and
+*lineage-style recomputation* — without a JVM:
+
+- a partition is a **pure function** ``() -> numpy arrays`` (lineage:
+  re-running it after a preemption reproduces the data; nothing is
+  cached that can't be rebuilt);
+- sharding is arithmetic over ``(host_id, num_hosts)`` — the same
+  partition always lands on the same host, so multi-host training is
+  reproducible and resumable.
+
+Transformations (``map``, ``map_partitions``, ``filter``) are lazy and
+compose lineage; ``reduce`` materialises. Batch iteration yields
+device-ready NHWC arrays.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class ShardedDataset:
+    """A list of lazily-evaluated partitions with RDD-style combinators."""
+
+    def __init__(self, partition_fns: Sequence[Callable[[], Any]]):
+        self._fns = list(partition_fns)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_arrays(cls, arrays: Any, num_partitions: int) -> "ShardedDataset":
+        """Split (a pytree of) arrays into roughly equal partitions."""
+        first = arrays[next(iter(arrays))] if isinstance(arrays, dict) else arrays
+        n = len(first)
+        per = math.ceil(n / num_partitions)
+
+        def make(i):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if isinstance(arrays, dict):
+                return lambda: {k: v[lo:hi] for k, v in arrays.items()}
+            return lambda: arrays[lo:hi]
+
+        return cls([make(i) for i in range(num_partitions) if i * per < n])
+
+    # -- combinators (lazy; compose lineage) ------------------------------
+    def map_partitions(self, fn: Callable[[Any], Any]) -> "ShardedDataset":
+        return ShardedDataset([(lambda f=f: fn(f())) for f in self._fns])
+
+    def map(self, fn: Callable[[Any], Any]) -> "ShardedDataset":
+        def per_part(part):
+            if isinstance(part, dict):
+                raise TypeError("map() over dict partitions: use map_partitions")
+            return [fn(x) for x in part]
+
+        return self.map_partitions(per_part)
+
+    # -- actions -----------------------------------------------------------
+    @property
+    def num_partitions(self) -> int:
+        return len(self._fns)
+
+    def collect_partition(self, i: int) -> Any:
+        return self._fns[i]()
+
+    def reduce(self, fn: Callable[[Any, Any], Any]) -> Any:
+        out = self._fns[0]()
+        for f in self._fns[1:]:
+            out = fn(out, f())
+        return out
+
+    # -- sharding ----------------------------------------------------------
+    def shard(self, host_id: int, num_hosts: int) -> "ShardedDataset":
+        """Deterministic host shard: partition i goes to host i % num_hosts."""
+        return ShardedDataset(
+            [f for i, f in enumerate(self._fns) if i % num_hosts == host_id]
+        )
+
+    # -- iteration ---------------------------------------------------------
+    def batches(
+        self,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        epochs: Optional[int] = None,
+        drop_remainder: bool = True,
+        transform: Optional[Callable[[Any, np.random.Generator], Any]] = None,
+    ) -> Iterator[Any]:
+        """Yield batches cycling over partitions (and epochs).
+
+        ``transform`` runs per-batch on host (augmentation) with a
+        per-batch RNG derived from (seed, epoch, step) — deterministic
+        and recomputable, like the rest of the lineage.
+        """
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            order = np.arange(len(self._fns))
+            rng = np.random.default_rng((seed, epoch))
+            if shuffle:
+                rng.shuffle(order)
+            # rows pool across partition boundaries, so partitions smaller
+            # than batch_size still contribute (and can never stall the
+            # iterator); leftover rows drop only at epoch end.
+            buf: Any = None
+            yielded = False
+
+            def emit(batch):
+                if transform is not None:
+                    batch = transform(batch, rng)
+                return batch
+
+            for pi in order:
+                part = self._fns[pi]()
+                keys = list(part.keys()) if isinstance(part, dict) else None
+                n = len(part[keys[0]]) if keys else len(part)
+                idx = np.arange(n)
+                if shuffle:
+                    rng.shuffle(idx)
+                part = {k: part[k][idx] for k in keys} if keys else part[idx]
+                if buf is None:
+                    buf = part
+                elif keys:
+                    buf = {k: np.concatenate([buf[k], part[k]]) for k in keys}
+                else:
+                    buf = np.concatenate([buf, part])
+                m = len(buf[keys[0]]) if keys else len(buf)
+                lo = 0
+                while lo + batch_size <= m:
+                    if keys:
+                        batch = {k: buf[k][lo : lo + batch_size] for k in keys}
+                    else:
+                        batch = buf[lo : lo + batch_size]
+                    yielded = True
+                    yield emit(batch)
+                    lo += batch_size
+                buf = (
+                    {k: buf[k][lo:] for k in keys} if keys else buf[lo:]
+                )
+            rem = len(buf[list(buf)[0]] if isinstance(buf, dict) else buf) if buf is not None else 0
+            if rem and not drop_remainder:
+                yielded = True
+                yield emit(buf)
+            if not yielded:
+                raise ValueError(
+                    f"dataset yields no batches: total rows per epoch < "
+                    f"batch_size={batch_size}"
+                )
+            epoch += 1

@@ -605,6 +605,8 @@ class Scale:
 
     @staticmethod
     def init(lp, rng, in_shapes):
+        if len(in_shapes) == 2:  # scale comes from the second bottom
+            return {}
         p = lp.scale_param
         bias = bool(p.get("bias_term", False)) if p else False
         c = in_shapes[0][-1]
@@ -661,6 +663,11 @@ class Eltwise:
         if op == "SUM":
             coeffs = [float(c) for c in p.get_all("coeff")] if p else []
             if coeffs:
+                if len(coeffs) != len(inputs):
+                    raise ValueError(
+                        f"layer {lp.name!r}: {len(coeffs)} eltwise coeffs "
+                        f"for {len(inputs)} bottoms"
+                    )
                 y = sum(c * x for c, x in zip(coeffs, inputs))
             else:
                 y = sum(inputs[1:], inputs[0])
@@ -769,26 +776,42 @@ class Flatten:
 
 
 class Reshape:
+    """Caffe reshape semantics operate on the NCHW view; we transpose a
+    4D NHWC input to NCHW, reshape, and transpose back when the result
+    is again 4D (non-4D results keep NCHW-order axes, like Caffe)."""
+
     @staticmethod
-    def _shape(lp, in_shape):
+    def _nchw_shape(lp, in_shape_nchw):
         p = lp.sub("reshape_param")
         dims = [int(d) for d in p.get("shape").get_all("dim")]
         out = []
         for i, d in enumerate(dims):
             if d == 0:
-                out.append(in_shape[i])
+                out.append(in_shape_nchw[i])
             else:
                 out.append(d)
-        # resolve a single -1
         if -1 in out:
             known = int(np.prod([d for d in out if d != -1]))
-            total = int(np.prod(in_shape))
+            total = int(np.prod(in_shape_nchw))
             out[out.index(-1)] = total // known
         return tuple(out)
 
     @staticmethod
+    def _shapes(lp, in_shape):
+        if len(in_shape) == 4:
+            n, h, w, c = in_shape
+            nchw_in = (n, c, h, w)
+        else:
+            nchw_in = tuple(in_shape)
+        nchw_out = Reshape._nchw_shape(lp, nchw_in)
+        if len(nchw_out) == 4:
+            n, c, h, w = nchw_out
+            return nchw_out, (n, h, w, c)
+        return nchw_out, nchw_out
+
+    @staticmethod
     def infer(lp, in_shapes):
-        return [Reshape._shape(lp, in_shapes[0])]
+        return [Reshape._shapes(lp, in_shapes[0])[1]]
 
     @staticmethod
     def init(lp, rng, in_shapes):
@@ -796,7 +819,14 @@ class Reshape:
 
     @staticmethod
     def apply(lp, params, state, inputs, ctx):
-        return [inputs[0].reshape(Reshape._shape(lp, inputs[0].shape))], None
+        x = inputs[0]
+        nchw_out, out = Reshape._shapes(lp, x.shape)
+        if x.ndim == 4:
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        y = x.reshape(nchw_out)
+        if len(nchw_out) == 4:
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return [y], None
 
 
 class Softmax:

@@ -149,7 +149,7 @@ class XLANet:
             for ti, top in enumerate(lp.top):
                 w = lp.loss_weight[ti] if ti < len(lp.loss_weight) else (1.0 if is_loss else 0.0)
                 if w:
-                    total = total + w * blobs[top].astype(jnp.float32)
+                    total = total + w * jnp.sum(blobs[top].astype(jnp.float32))
                 if is_loss or lp.type == "Accuracy":
                     metrics[top] = blobs[top]
         return total, metrics
