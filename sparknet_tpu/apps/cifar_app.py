@@ -22,7 +22,7 @@ from ..data.cifar import cifar10_dataset
 from ..data.preprocess import Transformer
 from ..nets import weights as W
 from ..proto import caffe_pb
-from ..solver.trainer import Solver
+from ..solver.trainer import Solver, resolve_model_path
 
 
 def _data_layer(net: caffe_pb.NetParameter, phase: str):
@@ -59,8 +59,8 @@ def build(args) -> tuple:
         sp.max_iter = args.max_iter
 
     net_path = sp.net or sp.train_net
-    if net_path and not os.path.exists(net_path):
-        net_path = os.path.join(solver_dir, os.path.basename(net_path))
+    if net_path:
+        net_path = resolve_model_path(net_path, solver_dir)
     net_param = caffe_pb.load_net(net_path) if net_path else sp.net_param
 
     train_layer = _data_layer(net_param, "TRAIN")

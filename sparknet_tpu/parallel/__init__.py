@@ -1,0 +1,42 @@
+"""Distribution layer: mesh construction, synchronous data parallelism,
+SparkNet's τ-local SGD, and (see sibling modules) sequence/tensor
+parallelism — all expressed as jax.sharding + collectives over ICI."""
+
+from .mesh import (
+    DP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from .data_parallel import make_dp_eval_step, make_dp_train_step
+from .local_sgd import (
+    init_local_opt_state,
+    make_local_sgd_round,
+    round_batch_sharding,
+    stack_round_batches,
+)
+from .trainer import ParallelSolver
+
+__all__ = [
+    "DP_AXIS",
+    "PP_AXIS",
+    "SP_AXIS",
+    "TP_AXIS",
+    "ParallelSolver",
+    "batch_sharding",
+    "init_local_opt_state",
+    "make_dp_eval_step",
+    "make_dp_train_step",
+    "make_local_sgd_round",
+    "make_mesh",
+    "replicate",
+    "replicated",
+    "round_batch_sharding",
+    "shard_batch",
+    "stack_round_batches",
+]

@@ -1,0 +1,88 @@
+"""Device mesh construction — the TPU-native cluster abstraction.
+
+The reference's "cluster" is Spark's driver + executor set, sized by
+``spark-submit --num-executors`` (SURVEY.md §1-2; reference mount empty,
+no file:line).  The TPU-native equivalent is a ``jax.sharding.Mesh``:
+a named, n-dimensional arrangement of chips over which ``pjit`` /
+``shard_map`` place computation, and over whose axes XLA collectives
+(psum / all_gather / ppermute) ride the ICI links.
+
+Axis-name conventions used across the framework:
+
+- ``"dp"``  — data parallelism (batch axis). SparkNet's only axis.
+- ``"tp"``  — tensor/model parallelism (hidden-dim sharding).
+- ``"sp"``  — sequence/context parallelism (ring attention).
+- ``"pp"``  — pipeline stages.
+
+A 1-D ``{"dp": N}`` mesh reproduces the reference's topology; the other
+axes are the capabilities the reference never had but a TPU pod gives
+for free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+SP_AXIS = "sp"
+PP_AXIS = "pp"
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh.
+
+    ``axes`` maps axis name -> size, in major-to-minor order; one axis may
+    be ``-1`` ("use all remaining devices").  Default: all devices on a
+    single ``"dp"`` axis — the reference's pure-data-parallel topology.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes) if axes else {DP_AXIS: n}
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if known == 0 or n % known:
+            raise ValueError(f"cannot infer -1 axis: {n} devices / {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"mesh {dict(zip(axes, sizes))} needs {total} devices, have {n}"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DP_AXIS) -> NamedSharding:
+    """Shard the leading (batch) axis over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DP_AXIS):
+    """Place a host batch onto the mesh, batch-axis sharded (the
+    reference's RDD-partition -> executor placement, but via ICI-aware
+    device_put instead of TCP shuffle)."""
+    s = batch_sharding(mesh, axis)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree across the mesh (the reference's driver
+    ``broadcast(WeightCollection)``, minus the serialization)."""
+    s = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)

@@ -22,6 +22,20 @@ from ..nets.xlanet import XLANet
 from .caffe_solver import init_opt_state, make_update_fn, mults_for_params
 
 
+def resolve_model_path(path: str, base_dir: str) -> str:
+    """Resolve a prototxt-referenced path like Caffe (relative to the
+    launch cwd) with relocatable-bundle fallbacks: the solver's own
+    directory, then the bare filename inside it."""
+    for cand in (
+        path,
+        os.path.join(base_dir, path),
+        os.path.join(base_dir, os.path.basename(path)),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return path
+
+
 def make_grad_fn(net: XLANet) -> Callable:
     """``grad_fn(params, state, batch, rng) -> (grads, new_state, metrics)``."""
 
@@ -108,9 +122,9 @@ class Solver:
                         "solver specifies no net (no net/train_net path, no "
                         "inline net_param, and none passed to Solver)"
                     )
-                if not os.path.exists(net_path):
-                    net_path = os.path.join(solver_dir, net_path)
-                net_param = caffe_pb.load_net(net_path)
+                net_param = caffe_pb.load_net(
+                    resolve_model_path(net_path, solver_dir)
+                )
         self.net_param = net_param
         self.train_net = XLANet(net_param, "TRAIN", input_shapes, compute_dtype)
         self.test_net = XLANet(

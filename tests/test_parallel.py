@@ -1,0 +1,229 @@
+"""Distribution layer tests on the 8-device virtual CPU mesh.
+
+Key invariants (mirroring the reference's algorithmic contract,
+SURVEY.md §1 core algorithm):
+- sync DP over a sharded global batch == single-device step on the same
+  batch (the all-reduce is exact, not approximate);
+- τ=1 local SGD == sync DP (averaging weights after one step with
+  momentum starting at 0 is identical to averaging gradients);
+- τ>1 local SGD still trains (loss decreases) and advances iter by τ.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.parallel import ParallelSolver, make_mesh
+from sparknet_tpu.solver.trainer import Solver
+
+TINY_NET = """
+name: "tiny"
+layer { name: "d" type: "Input" top: "data" top: "label" }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss" }
+"""
+
+SOLVER_TXT = "base_lr: 0.1 momentum: 0.9 lr_policy: 'fixed' weight_decay: 0.001"
+
+
+def tiny_net():
+    return caffe_pb.load_net(TINY_NET, is_path=False)
+
+
+def tiny_solver():
+    return caffe_pb.load_solver(SOLVER_TXT, is_path=False)
+
+
+def batch(seed, n=16):
+    rng = np.random.default_rng(seed)
+    return {
+        "data": jnp.asarray(rng.normal(size=(n, 8)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 4, size=(n,)), jnp.int32),
+    }
+
+
+SHAPES = {"data": (16, 8), "label": (16,)}
+
+
+def test_make_mesh_shapes():
+    m = make_mesh()
+    assert m.shape["dp"] == 8
+    m = make_mesh({"dp": 2, "tp": -1})
+    assert m.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3})
+
+
+def test_sync_dp_matches_single_device():
+    net = tiny_net()
+    single = Solver(tiny_solver(), SHAPES, net_param=net, seed=7)
+    par = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=net, seed=7, mesh=make_mesh(), mode="sync"
+    )
+    feed = [batch(i) for i in range(3)]
+    single.step(iter(list(feed)), 3)
+    par.step(iter(list(feed)), 3)
+    for layer in single.params:
+        for name in single.params[layer]:
+            np.testing.assert_allclose(
+                np.asarray(single.params[layer][name]),
+                np.asarray(par.params[layer][name]),
+                rtol=2e-5,
+                atol=1e-6,
+                err_msg=f"{layer}/{name}",
+            )
+
+
+def test_local_sgd_tau1_matches_sync():
+    net = tiny_net()
+    mesh = make_mesh()
+    sync = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=net, seed=7, mesh=mesh, mode="sync"
+    )
+    local = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=net, seed=7, mesh=mesh, mode="local", tau=1
+    )
+    feed = [batch(i) for i in range(2)]
+    sync.step(iter(list(feed)), 2)
+    local.step(iter(list(feed)), 2)
+    # τ=1: averaging post-step weights == averaging gradients, except the
+    # momentum buffers stay per-worker; with 2 steps they have begun to
+    # diverge at O(lr^2) — compare loosely but meaningfully.
+    for layer in sync.params:
+        for name in sync.params[layer]:
+            np.testing.assert_allclose(
+                np.asarray(sync.params[layer][name]),
+                np.asarray(local.params[layer][name]),
+                rtol=1e-3,
+                atol=1e-4,
+                err_msg=f"{layer}/{name}",
+            )
+
+
+def test_local_sgd_tau4_trains():
+    net = tiny_net()
+    s = ParallelSolver(
+        tiny_solver(),
+        SHAPES,
+        net_param=net,
+        seed=0,
+        mesh=make_mesh(),
+        mode="local",
+        tau=4,
+    )
+    fixed = batch(0)
+
+    def feed():
+        while True:
+            yield fixed
+
+    m0 = s.step(feed(), 4)
+    assert s.iter == 4
+    m1 = s.step(feed(), 40)
+    assert float(m1["loss"]) < float(m0["loss"])
+    assert float(m1["loss"]) < 0.2
+
+
+def test_local_sgd_metrics_replicated_and_batch_split():
+    """Each worker must see a distinct batch shard: train on data whose
+    label depends on the shard, and check the model fits all shards
+    (impossible if every device saw the same slice)."""
+    net = tiny_net()
+    mesh = make_mesh()
+    s = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=net, seed=1, mesh=mesh, mode="local", tau=2
+    )
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(16, 8)).astype(np.float32)
+    labels = (np.arange(16) // 4 % 4).astype(np.int32)  # varies across shards
+    b = {"data": jnp.asarray(data), "label": jnp.asarray(labels)}
+
+    def feed():
+        while True:
+            yield b
+
+    s.step(feed(), 60)
+    ev = s._eval_step(s.params, s.state, b)
+    assert float(ev["loss"]) < 0.3
+
+
+def test_local_sgd_partial_round_respects_n():
+    net = tiny_net()
+    s = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=net, seed=0,
+        mesh=make_mesh(), mode="local", tau=4,
+    )
+    consumed = []
+
+    def feed():
+        i = 0
+        while True:
+            consumed.append(i)
+            yield batch(i)
+            i += 1
+
+    s.step(feed(), 6)  # 4 + 2: second round is a partial tau=2 round
+    assert s.iter == 6
+    assert len(consumed) == 6
+
+
+def test_iter_size_parallel_modes():
+    """iter_size=2 must accumulate (not crash / not halve the batch) in
+    both modes; sync-vs-local τ=1 must agree like the plain case."""
+    net = tiny_net()
+    mesh = make_mesh()
+    sp_txt = SOLVER_TXT + " iter_size: 2"
+    shapes = {"data": (8, 8), "label": (8,)}
+    halves = [
+        {"data": batch(i)["data"][:8], "label": batch(i)["label"][:8]}
+        for i in range(4)
+    ]
+    sync = ParallelSolver(
+        caffe_pb.load_solver(sp_txt, is_path=False), shapes,
+        net_param=net, seed=7, mesh=mesh, mode="sync",
+    )
+    local = ParallelSolver(
+        caffe_pb.load_solver(sp_txt, is_path=False), shapes,
+        net_param=net, seed=7, mesh=mesh, mode="local", tau=1,
+    )
+    sync.step(iter(list(halves)), 2)
+    local.step(iter(list(halves)), 2)
+    for layer in sync.params:
+        for name in sync.params[layer]:
+            np.testing.assert_allclose(
+                np.asarray(sync.params[layer][name]),
+                np.asarray(local.params[layer][name]),
+                rtol=1e-3,
+                atol=1e-4,
+                err_msg=f"{layer}/{name}",
+            )
+
+
+def test_sync_dp_cifar_quick_smoke():
+    """The flagship prototxt compiles and trains under the 8-way mesh."""
+    from pathlib import Path
+
+    zoo = Path(__file__).resolve().parents[1] / "sparknet_tpu" / "models" / "prototxt"
+    sp = caffe_pb.load_solver(str(zoo / "cifar10_quick_solver.prototxt"))
+    shapes = {"data": (16, 32, 32, 3), "label": (16,)}
+    s = ParallelSolver(sp, shapes, solver_dir=str(zoo), mesh=make_mesh(), mode="sync")
+    rng = np.random.default_rng(0)
+    b = {
+        "data": jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32),
+        "label": jnp.asarray(np.arange(16) % 10, jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield b
+
+    m = s.step(feed(), 2)
+    assert np.isfinite(float(m["loss"]))
