@@ -1,0 +1,86 @@
+"""Benchmark: AlexNet training throughput (images/sec/chip).
+
+Runs the flagship ImageNetApp config — bvlc_alexnet, the reference's
+headline benchmark per BASELINE.json — as jitted train steps on the
+available accelerator and prints ONE JSON line.
+
+Baseline: the reference trains AlexNet inside Caffe on a GPU per
+executor.  Caffe's own published throughput figure ("4 ms/image for
+learning", i.e. ~250 images/s on the K40 of the SparkNet era) is the
+only per-chip reference number available with the reference mount empty
+(BASELINE.md: published numbers unverifiable); ``vs_baseline`` is
+computed against that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+CAFFE_K40_ALEXNET_IMG_PER_SEC = 250.0  # "4 ms/image for learning"
+
+
+def main() -> None:
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    sp = caffe_pb.load_solver(os.path.join(zoo, "bvlc_alexnet_solver.prototxt"))
+
+    platform = jax.devices()[0].platform
+    bs = int(os.environ.get("BENCH_BATCH", 512 if platform != "cpu" else 16))
+    compute_dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    shapes = {"data": (bs, 227, 227, 3), "label": (bs,)}
+    solver = Solver(sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 1000, size=(bs,)), jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    # Sync via a host scalar fetch: on tunneled backends
+    # block_until_ready can return before execution completes, so a
+    # device->host read of a value data-dependent on the full step chain
+    # is the only reliable fence.
+    m = solver.step(feed(), 2)  # warmup + compile
+    float(m["loss"])
+
+    iters = int(os.environ.get("BENCH_ITERS", 20 if platform != "cpu" else 4))
+    t0 = time.perf_counter()
+    m = solver.step(feed(), iters)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = bs * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_train_images_per_sec_per_chip",
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(img_per_sec / CAFFE_K40_ALEXNET_IMG_PER_SEC, 3),
+                "platform": platform,
+                "batch_size": bs,
+                "iters": iters,
+                "step_ms": round(1000 * dt / iters, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -190,10 +190,12 @@ class Convolution:
             rhs_dilation=(dh, dw),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=group,
-            preferred_element_type=jnp.float32,
+            # no preferred_element_type: the MXU already accumulates
+            # bf16 products in f32 internally, and an explicit f32
+            # output breaks the conv transpose rule under mixed dtypes.
         )
         if bias and "bias" in params:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return [y], None
 
 
@@ -230,10 +232,9 @@ class Deconvolution:
             rhs_dilation=(dh, dw),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=group,
-            preferred_element_type=jnp.float32,
         )
         if bias and "bias" in params:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return [y], None
 
 
@@ -342,10 +343,12 @@ class InnerProduct:
         x = inputs[0]
         x2 = x.reshape(x.shape[0], -1).astype(ctx.compute_dtype)
         w = params["weight"].astype(ctx.compute_dtype)
+        # unlike conv, dot's transpose rule handles a preferred f32
+        # output with bf16 operands, so keep guaranteed f32 accumulation
         y = jnp.dot(x2, w, preferred_element_type=jnp.float32)
         if bias and "bias" in params:
             y = y + params["bias"]
-        return [y], None
+        return [y.astype(ctx.compute_dtype)], None
 
 
 class ReLU:

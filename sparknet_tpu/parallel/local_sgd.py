@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..nets.xlanet import XLANet
 from ..proto.caffe_pb import SolverParameter
 from ..solver.caffe_solver import init_opt_state, make_update_fn, mults_for_params
-from ..solver.trainer import make_grad_fn
+from ..solver.trainer import accumulate_grads, make_grad_fn
 from .mesh import DP_AXIS
 
 
@@ -71,8 +71,10 @@ def make_local_sgd_round(
         # params/state arrive replicated but immediately diverge per
         # worker (local updates): mark them device-varying for shard_map's
         # replication typing so the scan carry has a stable type.
-        params = jax.tree_util.tree_map(lambda x: lax.pvary(x, dp_axis), params)
-        state = jax.tree_util.tree_map(lambda x: lax.pvary(x, dp_axis), state)
+        vary = lambda t: jax.tree_util.tree_map(
+            lambda x: lax.pcast(x, dp_axis, to="varying"), t
+        )
+        params, state = vary(params), vary(state)
         # inside shard_map: opt_state leading worker-axis is local size 1
         opt_local = jax.tree_util.tree_map(lambda x: x[0], opt_state)
         lr_m, dec_m = mults_for_params(params, specs)
@@ -82,16 +84,9 @@ def make_local_sgd_round(
 
         def grads_of(p, st, micro, step_rng):
             """One iteration's gradient; Caffe iter_size accumulation
-            (mean over micro-batches) when the extra axis is present."""
+            when the extra micro-batch axis is present."""
             if sp.iter_size > 1:
-                def micro_body(carry, mb):
-                    st_in, j = carry
-                    g, st2, m = grad_fn(p, st_in, mb, jax.random.fold_in(step_rng, j))
-                    return (st2, j + 1), (g, m)
-
-                (st2, _), (gs, ms) = lax.scan(micro_body, (st, 0), micro)
-                mean0 = lambda t: jax.tree_util.tree_map(lambda x: jnp.mean(x, 0), t)
-                return mean0(gs), st2, mean0(ms)
+                return accumulate_grads(grad_fn, p, st, micro, step_rng)
             return grad_fn(p, st, micro, step_rng)
 
         def body(carry, micro):
@@ -126,8 +121,17 @@ def make_local_sgd_round(
 
 
 def stack_round_batches(batch_list):
-    """Stack tau host batches into the ``[tau, global_bs, ...]`` layout."""
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batch_list)
+    """Stack tau host batches into the ``[tau, global_bs, ...]`` layout.
+
+    Stacks on the host (numpy): the caller's device_put then shards the
+    result straight onto the mesh, instead of committing the full round
+    batch to device 0 first and re-transferring.
+    """
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batch_list
+    )
 
 
 def round_batch_sharding(

@@ -51,11 +51,14 @@ class ParallelSolver(Solver):
         self.tau = int(tau)
         self.dp_axis = dp_axis
         ndp = self.mesh.shape[dp_axis]
-        bs = input_shapes[next(iter(input_shapes))][0]
-        if bs % ndp:
-            raise ValueError(
-                f"global batch {bs} not divisible by dp={ndp}"
-            )
+        for which, xnet in (("train", self.train_net), ("test", self.test_net)):
+            for name in xnet.input_names:
+                bs = xnet.blob_shapes[name][0]
+                if bs % ndp:
+                    raise ValueError(
+                        f"{which} input {name!r}: batch {bs} not divisible "
+                        f"by dp={ndp}"
+                    )
         self.params = replicate(self.params, self.mesh)
         self.state = replicate(self.state, self.mesh)
         if mode == "sync":

@@ -51,6 +51,22 @@ def make_grad_fn(net: XLANet) -> Callable:
     return grad_fn
 
 
+def accumulate_grads(grad_fn, params, state, micro_stack, rng):
+    """Caffe ``iter_size`` gradient accumulation: ``lax.scan`` over the
+    leading micro-batch axis, mean of grads and metrics.  Shared by the
+    single-device step and the local-SGD round so the semantics cannot
+    diverge."""
+
+    def body(carry, micro):
+        st, i = carry
+        g, st2, m = grad_fn(params, st, micro, jax.random.fold_in(rng, i))
+        return (st2, i + 1), (g, m)
+
+    (new_state, _), (gstack, mstack) = jax.lax.scan(body, (state, 0), micro_stack)
+    mean0 = lambda t: jax.tree_util.tree_map(lambda x: jnp.mean(x, 0), t)
+    return mean0(gstack), new_state, mean0(mstack)
+
+
 def make_train_step(net: XLANet, sp: caffe_pb.SolverParameter) -> Callable:
     """Returns jittable
     ``train_step(params, state, opt_state, batch, it, rng)
@@ -64,16 +80,9 @@ def make_train_step(net: XLANet, sp: caffe_pb.SolverParameter) -> Callable:
 
     def train_step(params, state, opt_state, batch, it, rng):
         if sp.iter_size > 1:
-            def body(carry, micro):
-                st, i = carry
-                g, st2, m = grad_fn(params, st, micro, jax.random.fold_in(rng, i))
-                return (st2, i + 1), (g, m)
-
-            (new_state, _), (gstack, mstack) = jax.lax.scan(
-                body, (state, 0), batch
+            grads, new_state, metrics = accumulate_grads(
+                grad_fn, params, state, batch, rng
             )
-            grads = jax.tree_util.tree_map(lambda g: jnp.mean(g, 0), gstack)
-            metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, 0), mstack)
         else:
             grads, new_state, metrics = grad_fn(params, state, batch, rng)
         specs = net.param_specs()
