@@ -43,10 +43,11 @@ def _batch_size(layer, default: int) -> int:
 def make_feed(
     ds, transformer: Transformer, batch_size: int, seed: int = 0
 ) -> Iterator[Dict[str, jnp.ndarray]]:
+    # host numpy out: placement is the solver's job (see imagenet_app)
     def transform(batch, rng):
         return {
-            "data": jnp.asarray(transformer(batch["data"], rng)),
-            "label": jnp.asarray(batch["label"], jnp.int32),
+            "data": np.asarray(transformer(batch["data"], rng), np.float32),
+            "label": np.asarray(batch["label"], np.int32),
         }
 
     return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)

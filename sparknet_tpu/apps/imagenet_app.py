@@ -1,0 +1,154 @@
+"""ImageNetApp — ImageNet end-to-end training entrypoint.
+
+Behavioral twin of the reference's ``ImageNetApp`` (SURVEY.md §2;
+``spark-submit`` there, ``python -m sparknet_tpu.apps.imagenet_app``
+here): picks an architecture from the zoo (AlexNet / GoogLeNet /
+ResNet-50 — the BASELINE.json ImageNetApp configs), loads ImageNet
+(folder / tar-shard / npz layouts, or synthetic), applies the net's
+``transform_param`` (256→crop, mirror, mean), and trains — single chip
+or across the mesh (``--parallel sync`` gradient all-reduce, or
+``--parallel local`` for the reference's τ-local-SGD averaging).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Dict, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.imagenet import imagenet_dataset
+from ..data.preprocess import Transformer
+from ..nets import weights as W
+from ..proto import caffe_pb
+from ..solver.trainer import Solver, resolve_model_path
+from ..parallel import ParallelSolver, make_mesh
+from .cifar_app import _batch_size, _data_layer, train_loop
+
+ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "prototxt")
+
+ARCH_SOLVERS = {
+    "alexnet": "bvlc_alexnet_solver.prototxt",
+    "googlenet": "bvlc_googlenet_quick_solver.prototxt",
+    "resnet50": "resnet50_solver.prototxt",
+}
+
+
+def make_feed(
+    ds, transformer: Transformer, batch_size: int, seed: int = 0
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    # yield host numpy (not device arrays): the solver/device_put layer
+    # owns placement, and pre-committed device arrays would force a
+    # D2H round-trip in ParallelSolver's local mode (stack_round_batches)
+    def transform(batch, rng):
+        return {
+            "data": np.asarray(transformer(batch["data"], rng), np.float32),
+            "label": np.asarray(batch["label"], np.int32),
+        }
+
+    return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
+
+
+def make_args(**overrides) -> argparse.Namespace:
+    """Programmatic equivalent of the CLI (tests, notebooks)."""
+    args = parser().parse_args([])
+    for k, v in overrides.items():
+        if not hasattr(args, k):
+            raise TypeError(f"unknown ImageNetApp arg {k!r}")
+        setattr(args, k, v)
+    return args
+
+
+def build(args):
+    solver_path = args.solver or os.path.join(ZOO, ARCH_SOLVERS[args.arch])
+    sp = caffe_pb.load_solver(solver_path)
+    solver_dir = os.path.dirname(os.path.abspath(solver_path))
+    if args.max_iter:
+        sp.max_iter = args.max_iter
+
+    net_path = sp.net or sp.train_net
+    if net_path:
+        net_path = resolve_model_path(net_path, solver_dir)
+    net_param = caffe_pb.load_net(net_path) if net_path else sp.net_param
+
+    train_layer = _data_layer(net_param, "TRAIN")
+    test_layer = _data_layer(net_param, "TEST")
+    train_bs = args.batch_size or _batch_size(train_layer, 32)
+    test_bs = args.batch_size or _batch_size(test_layer, train_bs)
+
+    data_dir = None if args.synthetic else args.data_dir
+    classes = args.synthetic_classes
+    train_ds = imagenet_dataset(
+        data_dir, train=True, synthetic_n=args.synthetic_n,
+        synthetic_classes=classes,
+    )
+    test_ds = imagenet_dataset(
+        data_dir, train=False, synthetic_n=args.synthetic_n,
+        synthetic_classes=classes,
+    )
+
+    train_tf = Transformer.from_message(
+        train_layer.transform_param if train_layer else None, train=True
+    )
+    test_tf = Transformer.from_message(
+        test_layer.transform_param if test_layer else None, train=False
+    )
+
+    crop = train_tf.crop_size or 224
+    test_crop = test_tf.crop_size or crop
+    shapes = {"data": (train_bs, crop, crop, 3), "label": (train_bs,)}
+    test_shapes = {"data": (test_bs, test_crop, test_crop, 3), "label": (test_bs,)}
+
+    kw = dict(
+        test_input_shapes=test_shapes,
+        net_param=net_param,
+        solver_dir=solver_dir,
+        seed=args.seed,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+    if args.parallel == "none":
+        solver = Solver(sp, shapes, **kw)
+    else:
+        solver = ParallelSolver(
+            sp, shapes, mesh=make_mesh(), mode=args.parallel, tau=args.tau, **kw
+        )
+    train_feed = make_feed(train_ds, train_tf, train_bs, seed=args.seed)
+    test_feed = make_feed(test_ds, test_tf, test_bs, seed=args.seed + 1)
+    return solver, train_feed, test_feed
+
+
+def parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description="ImageNet training (ImageNetApp)")
+    ap.add_argument("--arch", choices=sorted(ARCH_SOLVERS), default="alexnet")
+    ap.add_argument("--solver", default=None,
+                    help="explicit solver prototxt (overrides --arch)")
+    ap.add_argument("--data-dir", default=os.environ.get("IMAGENET_DIR"))
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--synthetic-n", type=int, default=2048)
+    ap.add_argument("--synthetic-classes", type=int, default=1000)
+    ap.add_argument("--max-iter", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=0)
+    ap.add_argument("--parallel", choices=("none", "sync", "local"),
+                    default="none")
+    ap.add_argument("--tau", type=int, default=10,
+                    help="local-SGD sync period (the SparkNet τ knob)")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bfloat16 compute (TPU-native matmul dtype)")
+    ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def main(argv=None):
+    args = parser().parse_args(argv)
+    solver, train_feed, test_feed = build(args)
+    print(
+        f"ImageNetApp: net={solver.net_param.name} "
+        f"params={W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
+    )
+    return train_loop(solver, train_feed, test_feed)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""ImageNet loader: folder/tar-shard layouts, npz shards, or synthetic.
+
+The reference's ImageNetApp reads ImageNet as tar shards (likely from
+S3) into an RDD of (image, label) pairs, resizing to 256x256 before the
+net's crop (SURVEY.md §2 data loaders; mount empty, no file:line). Here
+each layout becomes a list of pure partition functions feeding
+:class:`~sparknet_tpu.data.rdd.ShardedDataset` — same lineage- and
+shard-determinism guarantees as the reference's RDD path.
+
+Supported on-disk layouts (auto-detected under ``data_dir``):
+
+- ``train/<wnid>/*.JPEG`` image-folder (decoded with PIL, resized to
+  ``resize x resize``);
+- ``*.tar`` shards whose members are ``<wnid>_*.JPEG`` (reference-style
+  shard files; one partition per tar);
+- ``*.npz`` shards with ``data`` (N,H,W,3 uint8) + ``label`` arrays
+  (preprocessed fast path);
+- none of the above -> deterministic synthetic stand-in.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .rdd import ShardedDataset
+
+NUM_CLASSES = 1000
+RESIZE = 256  # Caffe's ImageNet prep: warp/resize to 256x256, crop at net
+
+# BGR channel means from the Caffe zoo prototxts (mean_value order).
+BGR_MEAN = np.array([104.0, 117.0, 123.0], np.float32)
+
+
+def _resize_uint8(img: "np.ndarray", size: int) -> np.ndarray:
+    from PIL import Image
+
+    return np.asarray(
+        Image.fromarray(img).convert("RGB").resize((size, size), Image.BILINEAR),
+        np.uint8,
+    )
+
+
+def _decode_jpeg(raw: bytes, size: int) -> np.ndarray:
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    return np.asarray(img.resize((size, size), Image.BILINEAR), np.uint8)
+
+
+def _wnid_index(wnids: Sequence[str]) -> Dict[str, int]:
+    return {w: i for i, w in enumerate(sorted(set(wnids)))}
+
+
+def _folder_partitions(
+    root: str, resize: int, files_per_part: int = 1024
+) -> Optional[List[Callable[[], Dict[str, np.ndarray]]]]:
+    if not os.path.isdir(root):
+        return None
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    if not classes:
+        return None
+    index = _wnid_index(classes)
+    files: List[Tuple[str, int]] = []
+    for wnid in classes:
+        cdir = os.path.join(root, wnid)
+        for f in sorted(os.listdir(cdir)):
+            if f.lower().endswith((".jpeg", ".jpg", ".png")):
+                files.append((os.path.join(cdir, f), index[wnid]))
+    if not files:
+        return None
+
+    def make(chunk: List[Tuple[str, int]]):
+        def load() -> Dict[str, np.ndarray]:
+            ims = np.stack(
+                [_decode_jpeg(open(p, "rb").read(), resize) for p, _ in chunk]
+            )
+            lbs = np.asarray([l for _, l in chunk], np.int32)
+            return {"data": ims, "label": lbs}
+
+        return load
+
+    return [
+        make(files[i : i + files_per_part])
+        for i in range(0, len(files), files_per_part)
+    ]
+
+
+def _tar_partitions(
+    data_dir: str, resize: int
+) -> Optional[List[Callable[[], Dict[str, np.ndarray]]]]:
+    tars = sorted(
+        os.path.join(data_dir, f)
+        for f in os.listdir(data_dir)
+        if f.endswith(".tar")
+    )
+    if not tars:
+        return None
+    # first pass over member names only, to build the global wnid index
+    wnids = set()
+    for t in tars:
+        with tarfile.open(t) as tf:
+            for name in tf.getnames():
+                base = os.path.basename(name)
+                if "_" in base:
+                    wnids.add(base.split("_")[0])
+    index = _wnid_index(sorted(wnids))
+
+    def make(path: str):
+        def load() -> Dict[str, np.ndarray]:
+            ims, lbs = [], []
+            with tarfile.open(path) as tf:
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    if not base.lower().endswith((".jpeg", ".jpg", ".png")):
+                        continue
+                    wnid = base.split("_")[0]
+                    if wnid not in index:
+                        continue
+                    ims.append(_decode_jpeg(tf.extractfile(m).read(), resize))
+                    lbs.append(index[wnid])
+            return {
+                "data": np.stack(ims),
+                "label": np.asarray(lbs, np.int32),
+            }
+
+        return load
+
+    return [make(t) for t in tars]
+
+
+def _npz_partitions(
+    data_dir: str, train: bool
+) -> Optional[List[Callable[[], Dict[str, np.ndarray]]]]:
+    tag = "train" if train else "val"
+    shards = sorted(
+        os.path.join(data_dir, f)
+        for f in os.listdir(data_dir)
+        if f.endswith(".npz") and tag in os.path.basename(f)
+    )
+    if not shards:
+        return None
+
+    def make(path: str):
+        def load() -> Dict[str, np.ndarray]:
+            z = np.load(path)
+            return {
+                "data": np.asarray(z["data"], np.uint8),
+                "label": np.asarray(z["label"], np.int32),
+            }
+
+        return load
+
+    return [make(s) for s in shards]
+
+
+def synthetic_imagenet(
+    n: int = 2048, seed: int = 0, size: int = RESIZE, classes: int = NUM_CLASSES
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in (class-keyed striped patches)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    images = rng.integers(0, 64, (n, size, size, 3)).astype(np.uint8)
+    span = max(1, size - 64)
+    for cls in np.unique(labels):
+        sel = labels == cls
+        r = (cls * 37) % span
+        c = (cls * 101) % span
+        images[sel, r : r + 48, c : c + 48, cls % 3] = 170 + (cls % 80)
+    return images, labels
+
+
+def imagenet_dataset(
+    data_dir: Optional[str],
+    train: bool = True,
+    resize: int = RESIZE,
+    synthetic_n: int = 2048,
+    synthetic_classes: int = NUM_CLASSES,
+) -> ShardedDataset:
+    """Dataset of {"data": uint8 NHWC 256x256, "label": int32}."""
+    if data_dir and os.path.isdir(data_dir):
+        parts = _npz_partitions(data_dir, train)
+        if parts is None:
+            sub = os.path.join(data_dir, "train" if train else "val")
+            parts = _folder_partitions(sub, resize)
+        if parts is None:
+            parts = _tar_partitions(data_dir, resize)
+        if parts is not None:
+            return ShardedDataset(parts)
+    images, labels = synthetic_imagenet(
+        synthetic_n if train else max(64, synthetic_n // 8),
+        seed=0 if train else 1,
+        size=resize,
+        classes=synthetic_classes,
+    )
+    return ShardedDataset.from_arrays(
+        {"data": images, "label": labels}, num_partitions=8
+    )

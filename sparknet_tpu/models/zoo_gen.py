@@ -1,0 +1,452 @@
+"""Model-zoo prototxt generators: GoogLeNet and ResNet-50.
+
+The reference ships the BVLC zoo prototxts (`bvlc_googlenet` is named in
+BASELINE.json's ImageNetApp configs; SURVEY.md §2 — reference mount
+empty, so these are regenerated from the published architectures, not
+copied). ResNet-50 is the BASELINE.json "new prototxt" config that
+exercises BatchNorm/Scale/Eltwise residual blocks.
+
+Both nets are emitted programmatically — an inception module is 7 convs
+plus a concat, a bottleneck block is 3 conv+BN+Scale stacks plus an
+Eltwise; writing ~2000 prototxt lines by hand invites typos the shape
+checker can't catch. Run ``python -m sparknet_tpu.models.zoo_gen`` to
+(re)write the files under ``models/prototxt/``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ZOO = os.path.join(_HERE, "prototxt")
+
+
+class W:
+    """Tiny indenting prototxt writer."""
+
+    def __init__(self) -> None:
+        self.lines: List[str] = []
+        self._ind = 0
+
+    def line(self, s: str) -> None:
+        self.lines.append("  " * self._ind + s)
+
+    def open(self, s: str) -> None:
+        self.line(s + " {")
+        self._ind += 1
+
+    def close(self) -> None:
+        self._ind -= 1
+        self.line("}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _params(w: W, lr_bias_double: bool = True, frozen: bool = False) -> None:
+    if frozen:
+        w.line("param { lr_mult: 0 decay_mult: 0 }")
+        return
+    w.line("param { lr_mult: 1 decay_mult: 1 }")
+    if lr_bias_double:
+        w.line("param { lr_mult: 2 decay_mult: 0 }")
+
+
+def conv(
+    w: W,
+    name: str,
+    bottom: str,
+    num: int,
+    kernel: int,
+    stride: int = 1,
+    pad: int = 0,
+    top: Optional[str] = None,
+    bias: bool = True,
+    filler: str = "xavier",
+    std: float = 0.01,
+    bias_value: float = 0.2,
+) -> str:
+    top = top or name
+    w.open("layer")
+    w.line(f'name: "{name}"')
+    w.line('type: "Convolution"')
+    w.line(f'bottom: "{bottom}"')
+    w.line(f'top: "{top}"')
+    _params(w, lr_bias_double=bias)
+    w.open("convolution_param")
+    w.line(f"num_output: {num}")
+    if pad:
+        w.line(f"pad: {pad}")
+    w.line(f"kernel_size: {kernel}")
+    if stride != 1:
+        w.line(f"stride: {stride}")
+    if not bias:
+        w.line("bias_term: false")
+    if filler == "gaussian":
+        w.line(f'weight_filler {{ type: "gaussian" std: {std} }}')
+    else:
+        w.line(f'weight_filler {{ type: "{filler}" }}')
+    if bias:
+        w.line(f'bias_filler {{ type: "constant" value: {bias_value} }}')
+    w.close()
+    w.close()
+    return top
+
+
+def relu(w: W, name: str, blob: str) -> str:
+    w.line(f'layer {{ name: "{name}" type: "ReLU" bottom: "{blob}" top: "{blob}" }}')
+    return blob
+
+
+def pool(
+    w: W,
+    name: str,
+    bottom: str,
+    mode: str,
+    kernel: int,
+    stride: int = 1,
+    pad: int = 0,
+    top: Optional[str] = None,
+) -> str:
+    top = top or name
+    geom = f"pool: {mode} kernel_size: {kernel} stride: {stride}"
+    if pad:
+        geom += f" pad: {pad}"
+    w.open("layer")
+    w.line(f'name: "{name}"')
+    w.line('type: "Pooling"')
+    w.line(f'bottom: "{bottom}"')
+    w.line(f'top: "{top}"')
+    w.line(f"pooling_param {{ {geom} }}")
+    w.close()
+    return top
+
+
+def fc(
+    w: W,
+    name: str,
+    bottom: str,
+    num: int,
+    top: Optional[str] = None,
+    filler: str = "xavier",
+    std: float = 0.01,
+    bias_value: float = 0.0,
+) -> str:
+    top = top or name
+    w.open("layer")
+    w.line(f'name: "{name}"')
+    w.line('type: "InnerProduct"')
+    w.line(f'bottom: "{bottom}"')
+    w.line(f'top: "{top}"')
+    _params(w)
+    w.open("inner_product_param")
+    w.line(f"num_output: {num}")
+    if filler == "gaussian":
+        w.line(f'weight_filler {{ type: "gaussian" std: {std} }}')
+    else:
+        w.line(f'weight_filler {{ type: "{filler}" }}')
+    w.line(f'bias_filler {{ type: "constant" value: {bias_value} }}')
+    w.close()
+    w.close()
+    return top
+
+
+def data_layers(w: W, crop: int, train_bs: int, test_bs: int) -> None:
+    for phase, bs, mirror in (("TRAIN", train_bs, True), ("TEST", test_bs, False)):
+        w.open("layer")
+        w.line('name: "data"')
+        w.line('type: "Data"')
+        w.line('top: "data"')
+        w.line('top: "label"')
+        w.line(f"include {{ phase: {phase} }}")
+        w.open("transform_param")
+        w.line(f"mirror: {'true' if mirror else 'false'}")
+        w.line(f"crop_size: {crop}")
+        for v in (104, 117, 123):
+            w.line(f"mean_value: {v}")
+        w.close()
+        w.line(f"data_param {{ batch_size: {bs} }}")
+        w.close()
+
+
+def softmax_head(w: W, prefix: str, bottom: str, loss_weight: float = 1.0) -> None:
+    w.open("layer")
+    w.line(f'name: "{prefix}/loss"')
+    w.line('type: "SoftmaxWithLoss"')
+    w.line(f'bottom: "{bottom}"')
+    w.line('bottom: "label"')
+    w.line(f'top: "{prefix}/loss"')
+    if loss_weight != 1.0:
+        w.line(f"loss_weight: {loss_weight}")
+    w.close()
+    for k in (1, 5):
+        w.open("layer")
+        w.line(f'name: "{prefix}/top-{k}"')
+        w.line('type: "Accuracy"')
+        w.line(f'bottom: "{bottom}"')
+        w.line('bottom: "label"')
+        w.line(f'top: "{prefix}/top-{k}"')
+        w.line("include { phase: TEST }")
+        if k != 1:
+            w.line(f"accuracy_param {{ top_k: {k} }}")
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (Szegedy et al. 2014, bvlc_googlenet layout)
+# ---------------------------------------------------------------------------
+
+def inception(w: W, prefix: str, bottom: str, c1, c3r, c3, c5r, c5, cp) -> str:
+    b1 = conv(w, f"{prefix}/1x1", bottom, c1, 1)
+    relu(w, f"{prefix}/relu_1x1", b1)
+    b3r = conv(w, f"{prefix}/3x3_reduce", bottom, c3r, 1)
+    relu(w, f"{prefix}/relu_3x3_reduce", b3r)
+    b3 = conv(w, f"{prefix}/3x3", b3r, c3, 3, pad=1)
+    relu(w, f"{prefix}/relu_3x3", b3)
+    b5r = conv(w, f"{prefix}/5x5_reduce", bottom, c5r, 1)
+    relu(w, f"{prefix}/relu_5x5_reduce", b5r)
+    b5 = conv(w, f"{prefix}/5x5", b5r, c5, 5, pad=2)
+    relu(w, f"{prefix}/relu_5x5", b5)
+    bp = pool(w, f"{prefix}/pool", bottom, "MAX", 3, 1, pad=1)
+    bpp = conv(w, f"{prefix}/pool_proj", bp, cp, 1)
+    relu(w, f"{prefix}/relu_pool_proj", bpp)
+    out = f"{prefix}/output"
+    w.open("layer")
+    w.line(f'name: "{out}"')
+    w.line('type: "Concat"')
+    for b in (b1, b3, b5, bpp):
+        w.line(f'bottom: "{b}"')
+    w.line(f'top: "{out}"')
+    w.close()
+    return out
+
+
+def aux_head(w: W, prefix: str, bottom: str) -> None:
+    p = pool(w, f"{prefix}/ave_pool", bottom, "AVE", 5, 3)
+    c = conv(w, f"{prefix}/conv", p, 128, 1)
+    relu(w, f"{prefix}/relu_conv", c)
+    f1 = fc(w, f"{prefix}/fc", c, 1024, bias_value=0.2)
+    relu(w, f"{prefix}/relu_fc", f1)
+    w.open("layer")
+    w.line(f'name: "{prefix}/drop_fc"')
+    w.line('type: "Dropout"')
+    w.line(f'bottom: "{f1}"')
+    w.line(f'top: "{f1}"')
+    w.line("dropout_param { dropout_ratio: 0.7 }")
+    w.close()
+    cls = fc(w, f"{prefix}/classifier", f1, 1000, std=0.0009765625)
+    softmax_head(w, prefix, cls, loss_weight=0.3)
+
+
+def googlenet() -> str:
+    w = W()
+    w.line("# GoogLeNet (Szegedy et al. 2014) in bvlc_googlenet train_val")
+    w.line("# layout — regenerated from the published architecture for the")
+    w.line("# reference's ImageNetApp GoogLeNet config (BASELINE.json;")
+    w.line("# SURVEY.md §2 — reference mount empty, nothing copied).")
+    w.line('name: "GoogleNet"')
+    data_layers(w, crop=224, train_bs=32, test_bs=50)
+
+    b = conv(w, "conv1/7x7_s2", "data", 64, 7, stride=2, pad=3)
+    relu(w, "conv1/relu_7x7", b)
+    b = pool(w, "pool1/3x3_s2", b, "MAX", 3, 2)
+    w.open("layer")
+    w.line('name: "pool1/norm1"')
+    w.line('type: "LRN"')
+    w.line(f'bottom: "{b}"')
+    w.line('top: "pool1/norm1"')
+    w.line("lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 }")
+    w.close()
+    b = conv(w, "conv2/3x3_reduce", "pool1/norm1", 64, 1)
+    relu(w, "conv2/relu_3x3_reduce", b)
+    b = conv(w, "conv2/3x3", b, 192, 3, pad=1)
+    relu(w, "conv2/relu_3x3", b)
+    w.open("layer")
+    w.line('name: "conv2/norm2"')
+    w.line('type: "LRN"')
+    w.line(f'bottom: "{b}"')
+    w.line('top: "conv2/norm2"')
+    w.line("lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 }")
+    w.close()
+    b = pool(w, "pool2/3x3_s2", "conv2/norm2", "MAX", 3, 2)
+
+    b = inception(w, "inception_3a", b, 64, 96, 128, 16, 32, 32)
+    b = inception(w, "inception_3b", b, 128, 128, 192, 32, 96, 64)
+    b = pool(w, "pool3/3x3_s2", b, "MAX", 3, 2)
+    b = inception(w, "inception_4a", b, 192, 96, 208, 16, 48, 64)
+    aux_head(w, "loss1", b)
+    b = inception(w, "inception_4b", b, 160, 112, 224, 24, 64, 64)
+    b = inception(w, "inception_4c", b, 128, 128, 256, 24, 64, 64)
+    b = inception(w, "inception_4d", b, 112, 144, 288, 32, 64, 64)
+    aux_head(w, "loss2", b)
+    b = inception(w, "inception_4e", b, 256, 160, 320, 32, 128, 128)
+    b = pool(w, "pool4/3x3_s2", b, "MAX", 3, 2)
+    b = inception(w, "inception_5a", b, 256, 160, 320, 32, 128, 128)
+    b = inception(w, "inception_5b", b, 384, 192, 384, 48, 128, 128)
+    b = pool(w, "pool5/7x7_s1", b, "AVE", 7, 1)
+    w.open("layer")
+    w.line('name: "pool5/drop_7x7_s1"')
+    w.line('type: "Dropout"')
+    w.line(f'bottom: "{b}"')
+    w.line(f'top: "{b}"')
+    w.line("dropout_param { dropout_ratio: 0.4 }")
+    w.close()
+    cls = fc(w, "loss3/classifier", b, 1000, filler="xavier")
+    softmax_head(w, "loss3", cls, loss_weight=1.0)
+    return w.text()
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 (He et al. 2015, Caffe BN+Scale layout)
+# ---------------------------------------------------------------------------
+
+def conv_bn(
+    w: W,
+    name: str,
+    bottom: str,
+    num: int,
+    kernel: int,
+    stride: int = 1,
+    pad: int = 0,
+    with_relu: bool = True,
+) -> str:
+    b = conv(
+        w, name, bottom, num, kernel, stride=stride, pad=pad, bias=False,
+        filler="msra",
+    )
+    w.open("layer")
+    w.line(f'name: "bn_{name}"')
+    w.line('type: "BatchNorm"')
+    w.line(f'bottom: "{b}"')
+    w.line(f'top: "{b}"')
+    w.line("batch_norm_param { moving_average_fraction: 0.9 }")
+    w.close()
+    w.open("layer")
+    w.line(f'name: "scale_{name}"')
+    w.line('type: "Scale"')
+    w.line(f'bottom: "{b}"')
+    w.line(f'top: "{b}"')
+    w.line("scale_param { bias_term: true }")
+    w.close()
+    if with_relu:
+        relu(w, f"{name}_relu", b)
+    return b
+
+
+def bottleneck(w: W, name: str, bottom: str, mid: int, out: int, stride: int, proj: bool) -> str:
+    """He-style bottleneck: 1x1(stride)-3x3-1x1 with identity/projection."""
+    if proj:
+        shortcut = conv_bn(
+            w, f"{name}_branch1", bottom, out, 1, stride=stride, with_relu=False
+        )
+    else:
+        shortcut = bottom
+    b = conv_bn(w, f"{name}_branch2a", bottom, mid, 1, stride=stride)
+    b = conv_bn(w, f"{name}_branch2b", b, mid, 3, pad=1)
+    b = conv_bn(w, f"{name}_branch2c", b, out, 1, with_relu=False)
+    top = name
+    w.open("layer")
+    w.line(f'name: "{top}"')
+    w.line('type: "Eltwise"')
+    w.line(f'bottom: "{shortcut}"')
+    w.line(f'bottom: "{b}"')
+    w.line(f'top: "{top}"')
+    w.close()
+    relu(w, f"{top}_relu", top)
+    return top
+
+
+def resnet50() -> str:
+    w = W()
+    w.line("# ResNet-50 (He et al. 2015) in Caffe BatchNorm+Scale train_val")
+    w.line("# layout — the BASELINE.json 'new prototxt' config exercising")
+    w.line("# BatchNorm/Scale/Eltwise residual blocks (not in the reference")
+    w.line("# zoo; nothing copied).")
+    w.line('name: "ResNet-50"')
+    data_layers(w, crop=224, train_bs=32, test_bs=25)
+    b = conv_bn(w, "conv1", "data", 64, 7, stride=2, pad=3)
+    b = pool(w, "pool1", b, "MAX", 3, 2)
+    stages = [
+        ("res2", 3, 64, 256, 1),
+        ("res3", 4, 128, 512, 2),
+        ("res4", 6, 256, 1024, 2),
+        ("res5", 3, 512, 2048, 2),
+    ]
+    for prefix, blocks, mid, out, stride in stages:
+        for i in range(blocks):
+            letter = chr(ord("a") + i)
+            b = bottleneck(
+                w,
+                f"{prefix}{letter}",
+                b,
+                mid,
+                out,
+                stride=stride if i == 0 else 1,
+                proj=(i == 0),
+            )
+    b = pool(w, "pool5", b, "AVE", 7, 1)
+    cls = fc(w, "fc1000", b, 1000, filler="xavier")
+    softmax_head(w, "loss", cls)
+    return w.text()
+
+
+def googlenet_solver() -> str:
+    return """# bvlc_googlenet quick_solver-style schedule (poly decay).
+net: "bvlc_googlenet_train_val.prototxt"
+test_iter: 200
+test_interval: 4000
+test_initialization: false
+display: 40
+base_lr: 0.01
+lr_policy: "poly"
+power: 0.5
+max_iter: 2400000
+momentum: 0.9
+weight_decay: 0.0002
+snapshot: 40000
+snapshot_prefix: "bvlc_googlenet"
+solver_mode: GPU
+"""
+
+
+def resnet50_solver() -> str:
+    return """# ResNet-50 schedule: step/10 at 30/60/80 epochs-equivalent.
+net: "resnet50_train_val.prototxt"
+test_iter: 400
+test_interval: 5000
+display: 20
+base_lr: 0.1
+lr_policy: "multistep"
+gamma: 0.1
+stepvalue: 150000
+stepvalue: 300000
+stepvalue: 400000
+max_iter: 450000
+momentum: 0.9
+weight_decay: 0.0001
+warmup_iter: 2500
+snapshot: 10000
+snapshot_prefix: "resnet50"
+solver_mode: GPU
+"""
+
+
+GENERATED = {
+    "bvlc_googlenet_train_val.prototxt": googlenet,
+    "bvlc_googlenet_quick_solver.prototxt": googlenet_solver,
+    "resnet50_train_val.prototxt": resnet50,
+    "resnet50_solver.prototxt": resnet50_solver,
+}
+
+
+def main() -> None:
+    for fname, gen in GENERATED.items():
+        path = os.path.join(ZOO, fname)
+        with open(path, "w") as f:
+            f.write(gen())
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

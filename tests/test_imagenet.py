@@ -1,0 +1,121 @@
+"""ImageNet data layer + ImageNetApp (the reference's second
+entrypoint, SURVEY.md §2)."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.imagenet import (
+    imagenet_dataset,
+    synthetic_imagenet,
+)
+
+
+def test_synthetic_imagenet_deterministic():
+    a, la = synthetic_imagenet(64, seed=0, size=64, classes=10)
+    b, lb = synthetic_imagenet(64, seed=0, size=64, classes=10)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(la, lb)
+    assert a.shape == (64, 64, 64, 3) and a.dtype == np.uint8
+    assert la.min() >= 0 and la.max() < 10
+
+
+def test_dataset_fallback_synthetic(tmp_path):
+    ds = imagenet_dataset(None, train=True, synthetic_n=64, synthetic_classes=5)
+    batch = next(ds.batches(8, epochs=1))
+    assert batch["data"].shape == (8, 256, 256, 3)
+    assert batch["label"].dtype == np.int32
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_folder_layout(tmp_path):
+    rng = np.random.default_rng(0)
+    for wnid in ("n01440764", "n01443537"):
+        d = tmp_path / "train" / wnid
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = rng.integers(0, 255, (32, 48, 3)).astype(np.uint8)
+            (d / f"{wnid}_{i}.png").write_bytes(_png_bytes(img))
+    ds = imagenet_dataset(str(tmp_path), train=True)
+    part = ds.collect_partition(0)
+    assert part["data"].shape == (6, 256, 256, 3)  # resized
+    # labels follow sorted-wnid indexing
+    assert sorted(np.unique(part["label"]).tolist()) == [0, 1]
+
+
+def test_tar_shard_layout(tmp_path):
+    rng = np.random.default_rng(1)
+    path = tmp_path / "shard-000.tar"
+    with tarfile.open(path, "w") as tf:
+        for wnid, k in (("n02084071", 2), ("n02121808", 1)):
+            for i in range(k):
+                raw = _png_bytes(rng.integers(0, 255, (20, 20, 3)).astype(np.uint8))
+                info = tarfile.TarInfo(f"{wnid}_{i}.png")
+                info.size = len(raw)
+                tf.addfile(info, io.BytesIO(raw))
+    ds = imagenet_dataset(str(tmp_path), train=True)
+    assert ds.num_partitions == 1
+    part = ds.collect_partition(0)
+    assert part["data"].shape == (3, 256, 256, 3)
+    assert sorted(part["label"].tolist()) == [0, 0, 1]
+
+
+def test_npz_shard_layout(tmp_path):
+    ims = np.zeros((10, 256, 256, 3), np.uint8)
+    lbs = np.arange(10, dtype=np.int32)
+    np.savez(tmp_path / "imagenet-train-000.npz", data=ims, label=lbs)
+    ds = imagenet_dataset(str(tmp_path), train=True)
+    part = ds.collect_partition(0)
+    assert part["data"].shape == (10, 256, 256, 3)
+    np.testing.assert_array_equal(part["label"], lbs)
+    # val split must not pick up train shards
+    ds_val = imagenet_dataset(str(tmp_path), train=False, synthetic_n=64)
+    assert ds_val.collect_partition(0)["data"].shape[0] != 10
+
+
+def test_imagenet_app_alexnet_synthetic_step():
+    """End-to-end: build ImageNetApp (AlexNet) on synthetic data and run
+    two train iterations."""
+    from sparknet_tpu.apps import imagenet_app
+
+    solver, train_feed, test_feed = imagenet_app.build(
+        imagenet_app.make_args(
+            synthetic=True,
+            synthetic_n=32,
+            synthetic_classes=10,
+            batch_size=4,
+            max_iter=2,
+        )
+    )
+    m = solver.step(train_feed, 2)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_imagenet_app_parallel_local_tau():
+    """τ-local-SGD over the 8-device CPU mesh through the app path."""
+    from sparknet_tpu.apps import imagenet_app
+
+    solver, train_feed, _ = imagenet_app.build(
+        imagenet_app.make_args(
+            synthetic=True,
+            synthetic_n=64,
+            synthetic_classes=10,
+            batch_size=8,
+            max_iter=4,
+            parallel="local",
+            tau=2,
+        )
+    )
+    m = solver.step(train_feed, 4)
+    assert np.isfinite(float(m["loss"]))
+    assert solver.iter == 4

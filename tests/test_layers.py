@@ -205,3 +205,52 @@ def test_lrn_within_channel_scale():
     interior = np.asarray(y)[0, 3, 3, 0]
     expected = 2.0 / (1.0 + alpha * 4.0) ** beta  # k ignored within-channel
     np.testing.assert_allclose(interior, expected, rtol=1e-6)
+
+
+def test_bf16_compute_grad_path():
+    """bfloat16 compute (the TPU matmul dtype): forward + grad through a
+    conv->IP->softmax net must produce finite f32 loss and grads — guards
+    the conv transpose rule against mixed-dtype regressions."""
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.nets.xlanet import XLANet
+
+    npm = caffe_pb.load_net(
+        """
+        name: "tiny"
+        layer { name: "data" type: "Input" top: "data" top: "label" }
+        layer {
+          name: "conv" type: "Convolution" bottom: "data" top: "conv"
+          convolution_param { num_output: 4 kernel_size: 3 pad: 1
+            weight_filler { type: "xavier" } }
+        }
+        layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+        layer {
+          name: "ip" type: "InnerProduct" bottom: "conv" top: "ip"
+          inner_product_param { num_output: 3
+            weight_filler { type: "gaussian" std: 0.1 } }
+        }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip"
+                bottom: "label" top: "loss" }
+        """,
+        is_path=False,
+    )
+    shapes = {"data": (2, 8, 8, 3), "label": (2,)}
+    net = XLANet(npm, "TRAIN", shapes, compute_dtype=jnp.bfloat16)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {
+        "data": jnp.asarray(np.random.default_rng(0).normal(size=shapes["data"]),
+                            jnp.float32),
+        "label": jnp.asarray([0, 2], jnp.int32),
+    }
+
+    def loss_fn(p):
+        blobs, _ = net.apply(p, state, batch, train=True, rng=None)
+        loss, _ = net.loss_and_metrics(blobs)
+        return loss
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert loss.dtype == jnp.float32 and np.isfinite(float(loss))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert flat and all(np.all(np.isfinite(np.asarray(g, np.float32))) for g in flat)
+    # params stay f32 master copies; grads match param dtype
+    assert all(g.dtype == jnp.float32 for g in flat)

@@ -1,0 +1,89 @@
+"""Model-zoo coverage: GoogLeNet and ResNet-50 (BASELINE.json configs
+3 and 4). Param counts are checked against the published totals — an
+exact match means every conv/fc/BN in the generated prototxts has the
+canonical geometry."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.nets.xlanet import XLANet
+from sparknet_tpu.solver.trainer import Solver
+
+ZOO = os.path.join(
+    os.path.dirname(__file__), "..", "sparknet_tpu", "models", "prototxt"
+)
+
+
+def _count(params):
+    return sum(int(np.prod(v.shape)) for lp in params.values() for v in lp.values())
+
+
+@pytest.mark.parametrize(
+    "proto,total",
+    [
+        # published totals: GoogLeNet 13.38M incl. both aux heads,
+        # ResNet-50 25.557M
+        ("bvlc_googlenet_train_val.prototxt", 13_378_280),
+        ("resnet50_train_val.prototxt", 25_557_032),
+    ],
+)
+def test_zoo_shapes_and_param_counts(proto, total):
+    npm = caffe_pb.load_net(os.path.join(ZOO, proto))
+    for phase in ("TRAIN", "TEST"):
+        net = XLANet(npm, phase, {"data": (2, 224, 224, 3), "label": (2,)})
+        assert net.blob_shapes["label"] == (2,)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    assert _count(params) == total
+
+
+def test_zoo_regen_is_stable(tmp_path):
+    """zoo_gen output matches the files checked into the zoo."""
+    from sparknet_tpu.models import zoo_gen
+
+    for fname, gen in zoo_gen.GENERATED.items():
+        with open(os.path.join(ZOO, fname)) as f:
+            assert f.read() == gen(), f"{fname} drifted from generator"
+
+
+def _one_step(solver_file, crop=224, bs=2, n=1):
+    sp = caffe_pb.load_solver(os.path.join(ZOO, solver_file))
+    shapes = {"data": (bs, crop, crop, 3), "label": (bs,)}
+    s = Solver(sp, shapes, solver_dir=ZOO)
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 1000, (bs,)), jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    return s, feed
+
+
+def test_resnet50_trains():
+    s, feed = _one_step("resnet50_solver.prototxt")
+    m0 = {k: float(v) for k, v in s.step(feed(), 1).items()}
+    assert np.isfinite(m0["loss/loss"])
+    # BatchNorm running stats must update in TRAIN phase
+    bn = s.state["bn_conv1"]
+    assert float(jnp.abs(bn["mean"]).sum()) > 0
+    m5 = {k: float(v) for k, v in s.step(feed(), 5).items()}
+    assert m5["loss/loss"] < m0["loss/loss"]  # memorizes the fixed batch
+
+
+def test_googlenet_trains():
+    s, feed = _one_step("bvlc_googlenet_quick_solver.prototxt")
+    m = {k: float(v) for k, v in s.step(feed(), 1).items()}
+    # three heads, aux weighted 0.3 (weighting applied in the loss sum,
+    # metrics report the raw per-head values)
+    for k in ("loss1/loss", "loss2/loss", "loss3/loss"):
+        assert np.isfinite(m[k])
+    # initial CE should be near ln(1000)
+    assert abs(m["loss3/loss"] - np.log(1000.0)) < 1.5
