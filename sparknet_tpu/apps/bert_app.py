@@ -1,0 +1,150 @@
+"""BertApp — BERT MLM pre-training entrypoint (pure-JAX model family).
+
+BASELINE.json config #5. No reference counterpart (SURVEY.md §2 —
+SparkNet predates transformers); the entrypoint shape mirrors
+CifarApp/ImageNetApp: pick a config, build feeds, drive the Solver —
+single chip or across the mesh (sync DP / τ-local SGD), AdamW with
+linear warmup + poly decay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.text import mlm_dataset, mlm_feed
+from ..models.bert import BertConfig, BertMLM
+from ..parallel import ParallelSolver, make_mesh
+from ..proto import caffe_pb
+from ..solver.trainer import Solver
+
+CONFIGS = {
+    "base": BertConfig.bert_base,
+    "small": BertConfig.bert_small,
+    "tiny": BertConfig.bert_tiny,
+}
+
+
+def make_solver_param(args) -> caffe_pb.SolverParameter:
+    """AdamW, linear warmup, poly(1.0) decay to zero — the standard BERT
+    pre-training schedule, expressed in SolverParameter terms."""
+    return caffe_pb.SolverParameter(
+        base_lr=args.lr,
+        lr_policy="poly",
+        power=1.0,
+        max_iter=args.max_iter,
+        warmup_iter=max(1, args.max_iter // 100),
+        momentum=0.9,
+        momentum2=0.999,
+        delta=1e-6,
+        weight_decay=0.01,
+        solver_type="ADAMW",
+        display=args.display,
+        random_seed=args.seed,
+    )
+
+
+def make_args(**overrides) -> argparse.Namespace:
+    args = parser().parse_args([])
+    for k, v in overrides.items():
+        if not hasattr(args, k):
+            raise TypeError(f"unknown BertApp arg {k!r}")
+        setattr(args, k, v)
+    return args
+
+
+def build(args):
+    cfg = CONFIGS[args.config]()
+    if args.vocab_size:
+        cfg = type(cfg)(**{**cfg.__dict__, "vocab_size": args.vocab_size})
+    seq = args.seq_len or min(128, cfg.max_position)
+    bs = args.batch_size
+    max_preds = max(1, int(seq * 0.15) + 1)
+
+    ds, vsize = mlm_dataset(
+        text_files=args.text_files or None,
+        vocab_size=cfg.vocab_size,
+        n_tokens=args.synthetic_tokens,
+        seq_len=seq,
+        seed=args.seed,
+    )
+    if vsize != cfg.vocab_size:  # corpus-built vocab may be smaller
+        cfg = type(cfg)(**{**cfg.__dict__, "vocab_size": vsize})
+
+    shapes = {
+        "input_ids": (bs, seq),
+        "mlm_positions": (bs, max_preds),
+    }
+    model = BertMLM(
+        cfg,
+        shapes,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        attention_impl=args.attention or None,
+    )
+    sp = make_solver_param(args)
+    if args.parallel == "none":
+        solver = Solver(sp, shapes, model=model, seed=args.seed)
+    else:
+        solver = ParallelSolver(
+            sp, shapes, model=model, seed=args.seed,
+            mesh=make_mesh(), mode=args.parallel, tau=args.tau,
+        )
+    feed = mlm_feed(ds, bs, cfg.vocab_size, max_preds, seed=args.seed)
+    return solver, feed, cfg
+
+
+def parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description="BERT MLM pre-training (BertApp)")
+    ap.add_argument("--config", choices=sorted(CONFIGS), default="base")
+    ap.add_argument("--vocab-size", type=int, default=0,
+                    help="override config vocab size")
+    ap.add_argument("--seq-len", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--max-iter", type=int, default=1000)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--display", type=int, default=20)
+    ap.add_argument("--text-files", nargs="*", default=None)
+    ap.add_argument("--synthetic-tokens", type=int, default=1 << 16)
+    ap.add_argument("--parallel", choices=("none", "sync", "local"),
+                    default="none")
+    ap.add_argument("--tau", type=int, default=10)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--attention", choices=("flash", "reference"), default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def main(argv=None) -> Dict[str, float]:
+    args = parser().parse_args(argv)
+    solver, feed, cfg = build(args)
+    n_params = solver.train_net.num_params(solver.params)
+    print(
+        f"BertApp: config={args.config} vocab={cfg.vocab_size} "
+        f"layers={cfg.num_layers} hidden={cfg.hidden_size} params={n_params}"
+    )
+    t0 = time.time()
+    metrics = {}
+    while solver.iter < args.max_iter:
+        n = min(args.display or 20, args.max_iter - solver.iter)
+        m = solver.step(
+            feed, n,
+            log_fn=lambda it, mm: print(
+                f"Iteration {it}, loss = {mm['loss']:.5f}, "
+                f"mlm_acc = {mm['mlm_acc']:.4f}"
+            ),
+        )
+        metrics = {k: float(v) for k, v in m.items()}
+    dt = time.time() - t0
+    print(
+        f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
+        f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
+    )
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -36,15 +36,6 @@ RESIZE = 256  # Caffe's ImageNet prep: warp/resize to 256x256, crop at net
 BGR_MEAN = np.array([104.0, 117.0, 123.0], np.float32)
 
 
-def _resize_uint8(img: "np.ndarray", size: int) -> np.ndarray:
-    from PIL import Image
-
-    return np.asarray(
-        Image.fromarray(img).convert("RGB").resize((size, size), Image.BILINEAR),
-        np.uint8,
-    )
-
-
 def _decode_jpeg(raw: bytes, size: int) -> np.ndarray:
     from PIL import Image
 

@@ -1,0 +1,317 @@
+"""BERT-base MLM — the pure-JAX transformer family (no prototxt path).
+
+BASELINE.json config #5: "BERT-base MLM (new — drop Caffe layer-lib,
+pure-JAX transformer stretch)". The reference has nothing comparable
+(SURVEY.md §2 — SparkNet predates transformers), so this is designed
+TPU-first rather than ported: bf16-friendly matmul shapes, attention via
+:mod:`sparknet_tpu.ops.attention` (Pallas flash on TPU), params in the
+same two-level ``WeightCollection`` layout the Caffe solver update fns
+consume, and the :class:`~sparknet_tpu.solver.trainer.Solver` protocol
+(``init/apply/loss_and_metrics/param_specs/input_names/blob_shapes``) so
+every training path — single chip, sync DP, τ-local SGD — works on BERT
+unchanged.
+
+Batch blobs:
+- ``input_ids``     (B, S) int32
+- ``token_type_ids``(B, S) int32
+- ``attention_mask``(B, S) int32 — 1 = real token
+- ``mlm_positions`` (B, M) int32 — indices into S
+- ``mlm_labels``    (B, M) int32
+- ``mlm_weights``   (B, M) float — 0 pads unused prediction slots
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+    @classmethod
+    def bert_base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def bert_small(cls) -> "BertConfig":
+        return cls(hidden_size=256, num_layers=4, num_heads=4,
+                   intermediate_size=1024)
+
+    @classmethod
+    def bert_tiny(cls, vocab_size: int = 1024) -> "BertConfig":
+        return cls(vocab_size=vocab_size, hidden_size=128, num_layers=2,
+                   num_heads=2, intermediate_size=512, max_position=128)
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _dropout(x, rate, rng, train):
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+class BertMLM:
+    """Functional BERT encoder + tied-embedding MLM head."""
+
+    def __init__(
+        self,
+        config: BertConfig,
+        input_shapes: Dict[str, Tuple[int, ...]],
+        compute_dtype: Any = jnp.float32,
+        attention_impl: Optional[str] = None,  # None=auto, "flash", "reference"
+    ):
+        self.cfg = config
+        self.compute_dtype = compute_dtype
+        self.attention_impl = attention_impl
+        if "input_ids" not in input_shapes:
+            raise ValueError("input_shapes must provide 'input_ids' (B, S)")
+        b, s = input_shapes["input_ids"]
+        m = input_shapes.get("mlm_positions", (b, max(1, s // 8)))[1]
+        self.batch, self.seq_len, self.num_preds = b, s, m
+        if s > config.max_position:
+            raise ValueError(f"seq {s} > max_position {config.max_position}")
+        if config.hidden_size % config.num_heads:
+            raise ValueError(
+                f"num_heads ({config.num_heads}) must divide hidden_size "
+                f"({config.hidden_size})"
+            )
+        self.input_names: List[str] = [
+            "input_ids", "token_type_ids", "attention_mask",
+            "mlm_positions", "mlm_labels", "mlm_weights",
+        ]
+        self.blob_shapes: Dict[str, Tuple[int, ...]] = {
+            "input_ids": (b, s),
+            "token_type_ids": (b, s),
+            "attention_mask": (b, s),
+            "mlm_positions": (b, m),
+            "mlm_labels": (b, m),
+            "mlm_weights": (b, m),
+            "loss": (),
+            "mlm_acc": (),
+        }
+
+    # -- init ----------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        cfg = self.cfg
+        h, i_sz, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+        std = cfg.initializer_range
+        keys = iter(jax.random.split(rng, 16 + 16 * cfg.num_layers))
+
+        def trunc(key, shape):
+            return (
+                jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+                * std
+            )
+
+        params: Dict[str, Dict[str, jax.Array]] = {
+            "embeddings": {
+                "word": trunc(next(keys), (v, h)),
+                "position": trunc(next(keys), (cfg.max_position, h)),
+                "token_type": trunc(next(keys), (cfg.type_vocab_size, h)),
+                "ln_scale": jnp.ones((h,), jnp.float32),
+                "ln_bias": jnp.zeros((h,), jnp.float32),
+            }
+        }
+        for li in range(cfg.num_layers):
+            params[f"layer_{li:02d}"] = {
+                "q_w": trunc(next(keys), (h, h)),
+                "q_b": jnp.zeros((h,), jnp.float32),
+                "k_w": trunc(next(keys), (h, h)),
+                "k_b": jnp.zeros((h,), jnp.float32),
+                "v_w": trunc(next(keys), (h, h)),
+                "v_b": jnp.zeros((h,), jnp.float32),
+                "out_w": trunc(next(keys), (h, h)),
+                "out_b": jnp.zeros((h,), jnp.float32),
+                "attn_ln_scale": jnp.ones((h,), jnp.float32),
+                "attn_ln_bias": jnp.zeros((h,), jnp.float32),
+                "ffn_in_w": trunc(next(keys), (h, i_sz)),
+                "ffn_in_b": jnp.zeros((i_sz,), jnp.float32),
+                "ffn_out_w": trunc(next(keys), (i_sz, h)),
+                "ffn_out_b": jnp.zeros((h,), jnp.float32),
+                "ffn_ln_scale": jnp.ones((h,), jnp.float32),
+                "ffn_ln_bias": jnp.zeros((h,), jnp.float32),
+            }
+        params["mlm_head"] = {
+            "dense_w": trunc(next(keys), (h, h)),
+            "dense_b": jnp.zeros((h,), jnp.float32),
+            "ln_scale": jnp.ones((h,), jnp.float32),
+            "ln_bias": jnp.zeros((h,), jnp.float32),
+            # decoder weight is tied to embeddings["word"]
+            "output_bias": jnp.zeros((v,), jnp.float32),
+        }
+        return params, {}
+
+    # -- encoder -------------------------------------------------------------
+    def encode(self, params, batch, *, train: bool, rng):
+        cfg = self.cfg
+        cdt = self.compute_dtype
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        emb = params["embeddings"]
+        x = (
+            emb["word"][ids]
+            + emb["position"][jnp.arange(s)][None, :, :]
+            + emb["token_type"][batch["token_type_ids"]]
+        )
+        x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"], cfg.layer_norm_eps)
+        if rng is not None:
+            rng_emb, rng = jax.random.split(rng)
+            x = _dropout(x, cfg.hidden_dropout, rng_emb, train)
+        x = x.astype(cdt)
+        kv_mask = batch["attention_mask"].astype(jnp.int32)
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+
+        for li in range(cfg.num_layers):
+            lp = params[f"layer_{li:02d}"]
+            lrng = jax.random.fold_in(rng, li) if rng is not None else None
+
+            def proj(w, b_, t):
+                y = jnp.dot(
+                    t, w.astype(cdt), preferred_element_type=jnp.float32
+                ) + b_
+                return y.astype(cdt)
+
+            q = proj(lp["q_w"], lp["q_b"], x).reshape(b, s, nh, hd)
+            k = proj(lp["k_w"], lp["k_b"], x).reshape(b, s, nh, hd)
+            v = proj(lp["v_w"], lp["v_b"], x).reshape(b, s, nh, hd)
+            # (B,S,H,D) -> (B,H,S,D)
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            if lrng is not None and train and cfg.attention_dropout > 0:
+                lrng, attn_rng = jax.random.split(lrng)
+            else:
+                attn_rng = None
+            ctx = attention(
+                q, k, v, kv_mask=kv_mask, force=self.attention_impl,
+                dropout_rate=cfg.attention_dropout if train else 0.0,
+                dropout_rng=attn_rng,
+            )
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
+            attn_out = proj(lp["out_w"], lp["out_b"], ctx)
+            if lrng is not None:
+                k1, k2 = jax.random.split(lrng)
+                attn_out = _dropout(attn_out, cfg.hidden_dropout, k1, train)
+            else:
+                k2 = None
+            x = _layer_norm(
+                x + attn_out, lp["attn_ln_scale"], lp["attn_ln_bias"],
+                cfg.layer_norm_eps,
+            ).astype(cdt)
+            ff = jax.nn.gelu(
+                proj(lp["ffn_in_w"], lp["ffn_in_b"], x), approximate=True
+            )
+            ff = proj(lp["ffn_out_w"], lp["ffn_out_b"], ff)
+            ff = _dropout(ff, cfg.hidden_dropout, k2, train)
+            x = _layer_norm(
+                x + ff, lp["ffn_ln_scale"], lp["ffn_ln_bias"],
+                cfg.layer_norm_eps,
+            ).astype(cdt)
+        return x
+
+    # -- Solver protocol -----------------------------------------------------
+    def apply(self, params, state, batch, *, train=None, rng=None):
+        cfg = self.cfg
+        train = bool(train)
+        x = self.encode(params, batch, train=train, rng=rng if train else None)
+        b, s, h = x.shape
+        pos = batch["mlm_positions"]  # (B, M)
+        gathered = jnp.take_along_axis(x, pos[:, :, None], axis=1)  # (B,M,H)
+        head = params["mlm_head"]
+        t = jax.nn.gelu(
+            jnp.dot(
+                gathered, head["dense_w"].astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            ) + head["dense_b"],
+            approximate=True,
+        )
+        t = _layer_norm(t, head["ln_scale"], head["ln_bias"], cfg.layer_norm_eps)
+        logits = (
+            jnp.dot(
+                t.astype(self.compute_dtype),
+                params["embeddings"]["word"].T.astype(self.compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            + head["output_bias"]
+        )  # (B, M, V) f32
+        labels = batch["mlm_labels"]
+        weights = batch["mlm_weights"].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, :, None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(weights), 1.0)
+        loss = jnp.sum(nll * weights) / denom
+        acc = jnp.sum(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * weights
+        ) / denom
+        return {"loss": loss, "mlm_acc": acc}, state
+
+    def loss_and_metrics(self, blobs):
+        return blobs["loss"], {"loss": blobs["loss"], "mlm_acc": blobs["mlm_acc"]}
+
+    def param_specs(self):
+        """BERT convention: no weight decay on biases/LayerNorm params,
+        expressed through Caffe decay_mult semantics."""
+
+        def spec_for(name: str) -> Tuple[float, float]:
+            nodecay = (
+                name.endswith("_b")
+                or name.endswith("_bias")
+                or "ln_" in name
+                or name in ("output_bias",)
+            )
+            return (1.0, 0.0 if nodecay else 1.0)
+
+        names = {
+            "embeddings": ["word", "position", "token_type", "ln_scale", "ln_bias"],
+            "mlm_head": ["dense_w", "dense_b", "ln_scale", "ln_bias", "output_bias"],
+        }
+        for li in range(self.cfg.num_layers):
+            names[f"layer_{li:02d}"] = [
+                "q_w", "q_b", "k_w", "k_b", "v_w", "v_b", "out_w", "out_b",
+                "attn_ln_scale", "attn_ln_bias", "ffn_in_w", "ffn_in_b",
+                "ffn_out_w", "ffn_out_b", "ffn_ln_scale", "ffn_ln_bias",
+            ]
+        return {layer: {n: spec_for(n) for n in ns} for layer, ns in names.items()}
+
+    def dummy_batch(self):
+        b, s, m = self.batch, self.seq_len, self.num_preds
+        return {
+            "input_ids": jnp.zeros((b, s), jnp.int32),
+            "token_type_ids": jnp.zeros((b, s), jnp.int32),
+            "attention_mask": jnp.ones((b, s), jnp.int32),
+            "mlm_positions": jnp.zeros((b, m), jnp.int32),
+            "mlm_labels": jnp.zeros((b, m), jnp.int32),
+            "mlm_weights": jnp.ones((b, m), jnp.float32),
+        }
+
+    def num_params(self, params) -> int:
+        import numpy as np
+
+        return sum(
+            int(np.prod(v.shape)) for lp in params.values() for v in lp.values()
+        )

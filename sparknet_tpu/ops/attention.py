@@ -1,0 +1,500 @@
+"""Attention ops: reference MHA, Pallas flash attention, dispatcher.
+
+The reference framework has no attention at all (SparkNet predates
+transformers — SURVEY.md §2 notes TP/SP/ring-attention obligations come
+from the task spec, not the reference). This module is the compute core
+for the BERT family and the long-context path:
+
+- :func:`mha_reference` — O(S^2)-memory jnp attention; numerics oracle
+  and CPU fallback.
+- :func:`flash_attention` — Pallas TPU kernel, online-softmax tiling in
+  VMEM (O(S) memory), f32 accumulation, custom VJP with flash backward
+  kernels. Supports causal masking, key-padding masks, and global
+  position offsets (``q_offset``/``kv_offset``) so ring-attention shards
+  can run the same kernel on their local slice of a longer sequence.
+- :func:`attention` — dispatcher: flash on TPU (or ``force="flash"``),
+  reference elsewhere.
+
+Layout: ``(batch, heads, seq, head_dim)`` throughout — seq in the
+sublane dim and head_dim in the lane dim keeps every matmul MXU-shaped.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on some hosts; import lazily
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (oracle + CPU fallback)
+# ---------------------------------------------------------------------------
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain attention. q,k,v: (B,H,S,D); kv_mask: (B,Sk) True=valid.
+
+    A query row with *no* valid key (fully padded) outputs exactly zero
+    and propagates zero gradients — same contract as the flash kernel.
+    """
+    *_, sq, d = q.shape
+    sk = k.shape[2]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    valid = jnp.ones((1, 1, sq, sk), bool)
+    if causal:
+        qi = jnp.arange(sq)[:, None] + q_offset
+        ki = jnp.arange(sk)[None, :] + kv_offset
+        valid = valid & (ki <= qi)[None, None]
+    if kv_mask is not None:
+        valid = valid & kv_mask[:, None, None, :].astype(bool)
+    logits = jnp.where(valid, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(jnp.any(valid, -1, keepdims=True), p, 0.0)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+def mha_reference_lse(q, k, v, **kw):
+    """Reference (out, logsumexp) — for testing flash internals."""
+    *_, d = q.shape
+    scale = kw.get("scale") or 1.0 / math.sqrt(d)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if kw.get("causal"):
+        sq, sk = q.shape[2], k.shape[2]
+        qi = jnp.arange(sq)[:, None] + kw.get("q_offset", 0)
+        ki = jnp.arange(sk)[None, :] + kw.get("kv_offset", 0)
+        logits = jnp.where(ki <= qi, logits, NEG_INF)
+    kv_mask = kw.get("kv_mask")
+    if kv_mask is not None:
+        logits = jnp.where(kv_mask[:, None, None, :], logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    out = mha_reference(q, k, v, **kw)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Flash forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(
+    off_ref,  # SMEM (2,): [q_offset, kv_offset]
+    q_ref,    # (1, 1, blk_q, d)
+    k_ref,    # (1, 1, sk, d)
+    v_ref,    # (1, 1, sk, d)
+    m_ref,    # (1, blk_k or sk) int8 kv mask slice... (1, sk)
+    o_ref,    # (1, 1, blk_q, d)
+    lse_ref,  # (1, 1, blk_q)
+    *,
+    causal: bool,
+    scale: float,
+    blk_k: int,
+):
+    qi = pl.program_id(2)
+    blk_q = q_ref.shape[2]
+    d = q_ref.shape[3]
+    sk = k_ref.shape[2]
+    nkb = sk // blk_k
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
+    q_offset = off_ref[0]
+    kv_offset = off_ref[1]
+    q_pos = (
+        jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        + qi * blk_q
+        + q_offset
+    )
+
+    def body(kb, carry):
+        acc, m_i, l_i = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (blk_q, blk_k)
+        kmask = m_ref[0, pl.ds(kb * blk_k, blk_k)]  # (blk_k,) int8
+        s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+        if causal:
+            k_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+                + kb * blk_k
+                + kv_offset
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((blk_q, d), jnp.float32)
+    m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q,), jnp.float32)
+    if causal:
+        # only blocks whose first key position can be <= the last query
+        # position participate; bound is traced (offsets are dynamic)
+        last_q = qi * blk_q + blk_q - 1 + q_offset
+        nkb_eff = jnp.clip(
+            (last_q - kv_offset) // blk_k + 1, 0, nkb
+        )
+    else:
+        nkb_eff = nkb
+    acc, m_i, l_i = jax.lax.fori_loop(0, nkb_eff, body, (acc0, m0, l0))
+    l_safe = jnp.maximum(l_i, 1e-30)
+    # a query row with no valid key (m_i never rose above NEG_INF)
+    # outputs zero, and its lse stays at NEG_INF so the backward
+    # kernels' masked-p guard zeroes its gradients too
+    dead = m_i <= NEG_INF * 0.5
+    o_ref[0, 0] = jnp.where(
+        dead[:, None], 0.0, acc / l_safe[:, None]
+    ).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(dead, NEG_INF, m_i + jnp.log(l_safe))
+
+
+# ---------------------------------------------------------------------------
+# Flash backward kernels (flash-2 style: dkv over k-blocks, dq over q-blocks)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(
+    off_ref, q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, *, causal: bool, scale: float, blk_k: int
+):
+    qi = pl.program_id(2)
+    blk_q, d = q_ref.shape[2], q_ref.shape[3]
+    sk = k_ref.shape[2]
+    nkb = sk // blk_k
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    q_offset, kv_offset = off_ref[0], off_ref[1]
+    q_pos = (
+        jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        + qi * blk_q + q_offset
+    )
+
+    def body(kb, dq):
+        k_blk = k_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        kmask = m_ref[0, pl.ds(kb * blk_k, blk_k)]
+        s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+        if causal:
+            k_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+                + kb * blk_k + kv_offset
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        # masked logits must yield p=0 even when lse is itself NEG_INF
+        # (fully-padded row): exp(NEG_INF - NEG_INF) would be 1
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse[:, None]))
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        last_q = qi * blk_q + blk_q - 1 + q_offset
+        nkb_eff = jnp.clip((last_q - kv_offset) // blk_k + 1, 0, nkb)
+    else:
+        nkb_eff = nkb
+    dq = jax.lax.fori_loop(
+        0, nkb_eff, body, jnp.zeros((blk_q, d), jnp.float32)
+    )
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    off_ref, q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, *, causal: bool, scale: float, blk_q: int
+):
+    ki = pl.program_id(2)
+    blk_k, d = k_ref.shape[2], k_ref.shape[3]
+    sq = q_ref.shape[2]
+    nqb = sq // blk_q
+    k_blk = k_ref[0, 0].astype(jnp.float32)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    kmask = m_ref[0, pl.ds(ki * blk_k, blk_k)]
+    q_offset, kv_offset = off_ref[0], off_ref[1]
+    k_pos = (
+        jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        + ki * blk_k + kv_offset
+    )
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+        if causal:
+            q_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+                + qb * blk_q + q_offset
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        # same masked-p guard as _bwd_dq_kernel (fully-padded rows)
+        p = jnp.where(
+            s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse[:, None])
+        )  # (blk_q, blk_k)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    if causal:
+        # first q block that can see this k block
+        first_q = jnp.clip(
+            (ki * blk_k + kv_offset - q_offset) // blk_q, 0, nqb
+        )
+    else:
+        first_q = 0
+    dk, dv = jax.lax.fori_loop(
+        first_q, nqb, body,
+        (jnp.zeros((blk_k, d), jnp.float32), jnp.zeros((blk_k, d), jnp.float32)),
+    )
+    # q entered the loop pre-scaled, so ds^T @ q already carries `scale`
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers + custom VJP
+# ---------------------------------------------------------------------------
+
+def _specs(b, h, sq, sk, d, blk_q):
+    """Common in_specs for (offsets, q, k, v, mask)."""
+    return [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets (2,)
+        pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, sk), lambda b_, h_, i: (b_, 0)),
+    ]
+
+
+def _flash_fwd(q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    grid = (b, h, sq // blk_q)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, blk_k=blk_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=_specs(b, h, sq, sk, d, blk_q),
+        out_specs=[
+            pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, blk_q), lambda b_, h_, i: (b_, h_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offsets, q, k, v, kv_mask)
+    return out, lse
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+)
+def _flash(q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret):
+    out, _ = _flash_fwd(
+        q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret
+    )
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret):
+    out, lse = _flash_fwd(
+        q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret
+    )
+    return out, (q, k, v, kv_mask, offsets, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, blk_q, blk_k, interpret, res, do):
+    q, k, v, kv_mask, offsets, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (b, h, sq)
+
+    bwd_in_specs = _specs(b, h, sq, sk, d, blk_q) + [
+        pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)),  # do
+        pl.BlockSpec((1, 1, blk_q), lambda b_, h_, i: (b_, h_, i)),  # lse
+        pl.BlockSpec((1, 1, blk_q), lambda b_, h_, i: (b_, h_, i)),  # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, scale=scale, blk_k=blk_k
+        ),
+        grid=(b, h, sq // blk_q),
+        in_specs=bwd_in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(offsets, q, k, v, kv_mask, do, lse, delta)
+
+    # dkv: grid over k blocks; q/do/lse/delta full rows resident
+    dkv_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),  # q
+        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),  # k
+        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),  # v
+        pl.BlockSpec((1, sk), lambda b_, h_, i: (b_, 0)),  # mask
+        pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),  # do
+        pl.BlockSpec((1, 1, sq), lambda b_, h_, i: (b_, h_, 0)),  # lse
+        pl.BlockSpec((1, 1, sq), lambda b_, h_, i: (b_, h_, 0)),  # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, scale=scale, blk_q=blk_q
+        ),
+        grid=(b, h, sk // blk_k),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(offsets, q, k, v, kv_mask, do, lse, delta)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention on (B,H,S,D). Block sizes snap down to the
+    largest divisor of the sequence length (gcd with the requested
+    block), so any length works — 128-multiples get full-size MXU
+    blocks; prefer those. Offsets may be traced scalars — ring
+    attention passes per-step shard offsets."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = math.gcd(sq, block_q)
+    block_k = math.gcd(sk, block_k)
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, sk), jnp.int8)
+    else:
+        kv_mask = kv_mask.astype(jnp.int8)
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+    )
+    return _flash(
+        q, k, v, kv_mask, offsets, causal, scale, block_q, block_k, interpret
+    )
+
+
+def attention(
+    q, k, v, *, causal=False, kv_mask=None, scale=None,
+    q_offset=0, kv_offset=0, dropout_rate=0.0, dropout_rng=None,
+    force: Optional[str] = None, **flash_kw
+):
+    """Dispatch: Pallas flash on TPU, reference elsewhere.
+
+    ``force`` = "flash" | "reference" overrides (tests, benchmarks).
+    Attention-probability dropout is only implemented in the reference
+    path; an active dropout (rate > 0 with an rng) routes there even on
+    TPU rather than silently skipping it.
+    """
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
+    use_flash = (
+        force == "flash"
+        or (force is None and jax.default_backend() == "tpu" and pltpu is not None)
+    ) and not dropping
+    if use_flash:
+        return flash_attention(
+            q, k, v, causal=causal, kv_mask=kv_mask, scale=scale,
+            q_offset=q_offset, kv_offset=kv_offset, **flash_kw
+        )
+    return mha_reference(
+        q, k, v, causal=causal, kv_mask=kv_mask, scale=scale,
+        q_offset=q_offset, kv_offset=kv_offset,
+        dropout_rate=dropout_rate if dropping else 0.0,
+        dropout_rng=dropout_rng,
+    )

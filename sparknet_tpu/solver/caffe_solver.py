@@ -55,7 +55,7 @@ def init_opt_state(sp: SolverParameter, params: Any) -> Dict[str, Any]:
     t = sp.solver_type.upper()
     if t in ("SGD", "NESTEROV"):
         return {"momentum": zeros()}
-    if t == "ADAM":
+    if t in ("ADAM", "ADAMW"):
         return {"m": zeros(), "v": zeros()}
     if t == "ADAGRAD":
         return {"h": zeros()}
@@ -121,16 +121,21 @@ def make_update_fn(
             new_v = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
             return new_p, {"momentum": new_v}
 
-        if t == "ADAM":
+        if t in ("ADAM", "ADAMW"):
             step = it.astype(jnp.float32) + 1.0
             b1, b2 = sp.momentum, sp.momentum2
             corr = jnp.sqrt(1.0 - jnp.power(b2, step)) / (1.0 - jnp.power(b1, step))
+            decoupled = t == "ADAMW"  # extension: decoupled decay (BERT)
 
             def upd(w, g, m, v, l, d):
-                g = _regularize(sp, g, w, d)
+                if not decoupled:
+                    g = _regularize(sp, g, w, d)
                 m2 = b1 * m + (1 - b1) * g
                 v2 = b2 * v + (1 - b2) * jnp.square(g)
-                return w - rate * l * corr * m2 / (jnp.sqrt(v2) + sp.delta), m2, v2
+                delta_w = rate * l * corr * m2 / (jnp.sqrt(v2) + sp.delta)
+                if decoupled:
+                    delta_w = delta_w + rate * l * sp.weight_decay * d * w
+                return w - delta_w, m2, v2
 
             out = jax.tree_util.tree_map(
                 upd, params, grads, opt_state["m"], opt_state["v"], lm, dm

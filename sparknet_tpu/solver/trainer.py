@@ -117,10 +117,32 @@ class Solver:
         test_input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
         net_param: Optional[caffe_pb.NetParameter] = None,
         solver_dir: str = ".",
-        compute_dtype: Any = jnp.float32,
+        compute_dtype: Any = None,
         seed: int = 0,
+        model: Any = None,
     ):
+        """``model``: any object satisfying the net protocol
+        (``init/apply/loss_and_metrics/param_specs/input_names/
+        blob_shapes``) — e.g. :class:`sparknet_tpu.models.bert.BertMLM` —
+        used for both phases in place of a prototxt-compiled XLANet.
+        With ``model``, ``compute_dtype`` (if given) overrides the
+        model's own; ``net_param``/``test_input_shapes`` don't apply and
+        are rejected so a caller can't believe they took effect.
+        """
         self.sp = solver
+        if model is not None:
+            if net_param is not None or test_input_shapes is not None:
+                raise ValueError(
+                    "Solver(model=...) is exclusive with net_param/"
+                    "test_input_shapes — the model defines its own net"
+                )
+            if compute_dtype is not None:
+                model.compute_dtype = compute_dtype
+            self.net_param = getattr(model, "net_param", None)
+            self.train_net = self.test_net = model
+            self._finish_init(solver, seed)
+            return
+        compute_dtype = jnp.float32 if compute_dtype is None else compute_dtype
         if net_param is None:
             if solver.net_param is not None:
                 net_param = solver.net_param
@@ -139,6 +161,9 @@ class Solver:
         self.test_net = XLANet(
             net_param, "TEST", test_input_shapes or input_shapes, compute_dtype
         )
+        self._finish_init(solver, seed)
+
+    def _finish_init(self, solver: caffe_pb.SolverParameter, seed: int) -> None:
         seed = solver.random_seed if solver.random_seed >= 0 else seed
         self.rng = jax.random.PRNGKey(seed)
         self.rng, init_rng = jax.random.split(self.rng)
