@@ -163,3 +163,42 @@ def mlm_feed(
         }
 
     return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
+
+
+def mlm_feed_tokens(
+    ds: ShardedDataset,
+    batch_size: int,
+    vocab_size: int,
+    seed: int = 0,
+    mask_prob: float = 0.15,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Token-level MLM batches for sequence-parallel training: labels and
+    weights are (B, S) arrays (shardable along S), plus global
+    ``position_ids`` — the layout
+    :func:`sparknet_tpu.parallel.sequence.make_sp_train_step` consumes."""
+
+    def transform(batch, rng):
+        toks = batch["tokens"]
+        b, s = toks.shape
+        ids = np.empty((b, s), np.int32)
+        labels = np.zeros((b, s), np.int32)
+        weights = np.zeros((b, s), np.float32)
+        max_preds = max(1, int(round(s * mask_prob)) + 1)
+        for i in range(b):
+            o, p, l, w = mlm_mask(toks[i], rng, vocab_size, max_preds, mask_prob)
+            ids[i] = o
+            n = int(w.sum())
+            labels[i, p[:n]] = l[:n]
+            weights[i, p[:n]] = 1.0
+        return {
+            "input_ids": ids,
+            "token_type_ids": np.zeros((b, s), np.int32),
+            "attention_mask": (toks != PAD).astype(np.int32),
+            "position_ids": np.broadcast_to(
+                np.arange(s, dtype=np.int32), (b, s)
+            ).copy(),
+            "mlm_labels": labels,
+            "mlm_weights": weights,
+        }
+
+    return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)

@@ -69,6 +69,51 @@ def _layer_norm(x, scale, bias, eps):
     return (y * scale + bias).astype(x.dtype)
 
 
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_copy(x, axis):
+    """Megatron's "f" operator: identity forward, psum backward. Placed
+    where a replicated activation enters column-parallel matmuls, it
+    reduces the partial per-rank input-cotangents so every upstream
+    (replicated) parameter sees the full gradient on every tp rank —
+    which is what lets the train step skip tp gradient all-reduces for
+    replicated params entirely."""
+    return x
+
+
+def _tp_copy_fwd(x, axis):
+    return x, None
+
+
+def _tp_copy_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+_tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_reduce(x, axis):
+    """Megatron's "g" operator: psum forward, identity backward. Raw
+    ``lax.psum`` transposes to another psum under shard_map, which would
+    scale the (already tp-identical) cotangent by the axis size; the
+    correct adjoint of sum-then-replicate is identity per rank."""
+    return jax.lax.psum(x, axis)
+
+
+def _tp_reduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _tp_reduce_bwd(axis, _, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
 def _dropout(x, rate, rng, train):
     if not train or rate <= 0.0 or rng is None:
         return x
@@ -84,11 +129,20 @@ class BertMLM:
         config: BertConfig,
         input_shapes: Dict[str, Tuple[int, ...]],
         compute_dtype: Any = jnp.float32,
-        attention_impl: Optional[str] = None,  # None=auto, "flash", "reference"
+        attention_impl: Optional[str] = None,
+        # None=auto, "flash", "reference", or — inside shard_map over a
+        # sequence-sharded mesh axis — "ring" / "ulysses"
+        sp_axis: str = "sp",
+        # set inside shard_map over a tensor-parallel axis: layer weights
+        # arrive sharded (column-parallel qkv/ffn_in, row-parallel
+        # out/ffn_out) and row-parallel projections psum over this axis
+        tp_axis: Optional[str] = None,
     ):
         self.cfg = config
         self.compute_dtype = compute_dtype
         self.attention_impl = attention_impl
+        self.sp_axis = sp_axis
+        self.tp_axis = tp_axis
         if "input_ids" not in input_shapes:
             raise ValueError("input_shapes must provide 'input_ids' (B, S)")
         b, s = input_shapes["input_ids"]
@@ -168,71 +222,119 @@ class BertMLM:
         return params, {}
 
     # -- encoder -------------------------------------------------------------
-    def encode(self, params, batch, *, train: bool, rng):
+    def embed(self, params, batch, *, train: bool, rng):
+        """Embedding sum + LN + dropout (the encoder prologue). Returns
+        (x, kv_mask, rng') — split out so pipeline stages can run it
+        outside the layer loop."""
         cfg = self.cfg
-        cdt = self.compute_dtype
         ids = batch["input_ids"]
-        b, s = ids.shape
+        s = ids.shape[1]
         emb = params["embeddings"]
-        x = (
-            emb["word"][ids]
-            + emb["position"][jnp.arange(s)][None, :, :]
-            + emb["token_type"][batch["token_type_ids"]]
+        # position_ids lets sequence-sharded callers pass each shard's
+        # global positions (they shard along S with the rest of the batch)
+        pos_ids = batch.get("position_ids")
+        pos_emb = (
+            emb["position"][jnp.arange(s)][None, :, :]
+            if pos_ids is None
+            else emb["position"][pos_ids]
         )
+        x = emb["word"][ids] + pos_emb + emb["token_type"][batch["token_type_ids"]]
         x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"], cfg.layer_norm_eps)
         if rng is not None:
             rng_emb, rng = jax.random.split(rng)
             x = _dropout(x, cfg.hidden_dropout, rng_emb, train)
-        x = x.astype(cdt)
+        x = x.astype(self.compute_dtype)
         kv_mask = batch["attention_mask"].astype(jnp.int32)
-        nh = cfg.num_heads
-        hd = cfg.hidden_size // nh
+        return x, kv_mask, rng
+
+    def encode(self, params, batch, *, train: bool, rng):
+        cfg = self.cfg
+        x, kv_mask, rng = self.embed(params, batch, train=train, rng=rng)
 
         for li in range(cfg.num_layers):
             lp = params[f"layer_{li:02d}"]
             lrng = jax.random.fold_in(rng, li) if rng is not None else None
+            x = self.layer_apply(lp, x, kv_mask, rng=lrng, train=train)
+        return x
 
-            def proj(w, b_, t):
-                y = jnp.dot(
-                    t, w.astype(cdt), preferred_element_type=jnp.float32
-                ) + b_
-                return y.astype(cdt)
+    def layer_apply(self, lp, x, kv_mask, *, rng=None, train=False):
+        """One encoder layer (attention + FFN with post-LN residuals).
 
-            q = proj(lp["q_w"], lp["q_b"], x).reshape(b, s, nh, hd)
-            k = proj(lp["k_w"], lp["k_b"], x).reshape(b, s, nh, hd)
-            v = proj(lp["v_w"], lp["v_b"], x).reshape(b, s, nh, hd)
-            # (B,S,H,D) -> (B,H,S,D)
-            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-            if lrng is not None and train and cfg.attention_dropout > 0:
-                lrng, attn_rng = jax.random.split(lrng)
-            else:
-                attn_rng = None
-            ctx = attention(
-                q, k, v, kv_mask=kv_mask, force=self.attention_impl,
+        Factored out of :meth:`encode` so pipeline parallelism can scan
+        a stage's stacked layer params through the identical math.
+        """
+        cfg = self.cfg
+        cdt = self.compute_dtype
+        b, s, _ = x.shape
+        hd = cfg.hidden_size // cfg.num_heads
+        tp = self.tp_axis
+
+        def proj(w, b_, t):
+            y = jnp.dot(
+                t, w.astype(cdt), preferred_element_type=jnp.float32
+            ) + b_
+            return y.astype(cdt)
+
+        def row_proj(w, b_, t):
+            """Row-parallel projection: local partial matmul, f/g-correct
+            psum over tp (if sharded), replicated bias."""
+            y = jnp.dot(
+                t, w.astype(cdt), preferred_element_type=jnp.float32
+            )
+            if tp is not None:
+                y = _tp_reduce(y, tp)
+            return (y + b_).astype(cdt)
+
+        # column-parallel under tp: q_w is (h, h/ntp), so the local
+        # head count falls out of the weight shape
+        nh = lp["q_w"].shape[-1] // hd
+        x_in = _tp_copy(x, tp) if tp is not None else x
+        q = proj(lp["q_w"], lp["q_b"], x_in).reshape(b, s, nh, hd)
+        k = proj(lp["k_w"], lp["k_b"], x_in).reshape(b, s, nh, hd)
+        v = proj(lp["v_w"], lp["v_b"], x_in).reshape(b, s, nh, hd)
+        # (B,S,H,D) -> (B,H,S,D)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if rng is not None and train and cfg.attention_dropout > 0:
+            rng, attn_rng = jax.random.split(rng)
+        else:
+            attn_rng = None
+        impl = self.attention_impl
+        if impl in ("ring", "ulysses"):
+            from ..parallel.sequence import ring_attention, ulysses_attention
+
+            sp_fn = ring_attention if impl == "ring" else ulysses_attention
+            ctx = sp_fn(
+                q, k, v, axis_name=self.sp_axis, kv_mask=kv_mask,
                 dropout_rate=cfg.attention_dropout if train else 0.0,
                 dropout_rng=attn_rng,
             )
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
-            attn_out = proj(lp["out_w"], lp["out_b"], ctx)
-            if lrng is not None:
-                k1, k2 = jax.random.split(lrng)
-                attn_out = _dropout(attn_out, cfg.hidden_dropout, k1, train)
-            else:
-                k2 = None
-            x = _layer_norm(
-                x + attn_out, lp["attn_ln_scale"], lp["attn_ln_bias"],
-                cfg.layer_norm_eps,
-            ).astype(cdt)
-            ff = jax.nn.gelu(
-                proj(lp["ffn_in_w"], lp["ffn_in_b"], x), approximate=True
+        else:
+            ctx = attention(
+                q, k, v, kv_mask=kv_mask, force=impl,
+                dropout_rate=cfg.attention_dropout if train else 0.0,
+                dropout_rng=attn_rng,
             )
-            ff = proj(lp["ffn_out_w"], lp["ffn_out_b"], ff)
-            ff = _dropout(ff, cfg.hidden_dropout, k2, train)
-            x = _layer_norm(
-                x + ff, lp["ffn_ln_scale"], lp["ffn_ln_bias"],
-                cfg.layer_norm_eps,
-            ).astype(cdt)
-        return x
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+        attn_out = row_proj(lp["out_w"], lp["out_b"], ctx)
+        if rng is not None:
+            k1, k2 = jax.random.split(rng)
+            attn_out = _dropout(attn_out, cfg.hidden_dropout, k1, train)
+        else:
+            k2 = None
+        x = _layer_norm(
+            x + attn_out, lp["attn_ln_scale"], lp["attn_ln_bias"],
+            cfg.layer_norm_eps,
+        ).astype(cdt)
+        ff_in = _tp_copy(x, tp) if tp is not None else x
+        ff = jax.nn.gelu(
+            proj(lp["ffn_in_w"], lp["ffn_in_b"], ff_in), approximate=True
+        )
+        ff = row_proj(lp["ffn_out_w"], lp["ffn_out_b"], ff)
+        ff = _dropout(ff, cfg.hidden_dropout, k2, train)
+        return _layer_norm(
+            x + ff, lp["ffn_ln_scale"], lp["ffn_ln_bias"],
+            cfg.layer_norm_eps,
+        ).astype(cdt)
 
     # -- Solver protocol -----------------------------------------------------
     def apply(self, params, state, batch, *, train=None, rng=None):
@@ -269,6 +371,52 @@ class BertMLM:
             (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * weights
         ) / denom
         return {"loss": loss, "mlm_acc": acc}, state
+
+    def token_loss_sums(self, params, state, batch, *, train=False, rng=None):
+        """Token-level MLM loss pieces for sequence-sharded training.
+
+        Unlike :meth:`apply` (which gathers ``mlm_positions`` — a global
+        -index gather that cannot run on a sequence shard), this scores
+        *every* local position and weights by ``mlm_weights`` of shape
+        (B, S_local). Returns local partial sums
+        ``(nll_sum, weight_sum, correct_sum)`` for the caller (the SP
+        train step) to ``psum`` over the mesh.
+        """
+        x = self.encode(params, batch, train=bool(train), rng=rng)
+        return self.token_loss_from_hidden(
+            params, x, batch["mlm_labels"], batch["mlm_weights"]
+        )
+
+    def token_loss_from_hidden(self, params, x, labels, weights):
+        """MLM head + per-token NLL over hidden states ``x`` (B, S, H).
+        Returns local partial sums (nll_sum, weight_sum, correct_sum)."""
+        cfg = self.cfg
+        head = params["mlm_head"]
+        t = jax.nn.gelu(
+            jnp.dot(
+                x, head["dense_w"].astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            ) + head["dense_b"],
+            approximate=True,
+        )
+        t = _layer_norm(t, head["ln_scale"], head["ln_bias"], cfg.layer_norm_eps)
+        logits = (
+            jnp.dot(
+                t.astype(self.compute_dtype),
+                params["embeddings"]["word"].T.astype(self.compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            + head["output_bias"]
+        )  # (B, S_local, V)
+        weights = weights.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return (
+            jnp.sum(nll * weights),
+            jnp.sum(weights),
+            jnp.sum(correct * weights),
+        )
 
     def loss_and_metrics(self, blobs):
         return blobs["loss"], {"loss": blobs["loss"], "mlm_acc": blobs["mlm_acc"]}

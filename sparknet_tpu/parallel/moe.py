@@ -1,0 +1,150 @@
+"""Mixture-of-Experts FFN with expert parallelism over an "ep" axis.
+
+No reference counterpart (SURVEY.md §2: data parallelism only; EP is a
+task-spec obligation). Switch-Transformer-style top-1 routing with a
+fixed per-expert capacity, expressed as dense one-hot dispatch/combine
+einsums — static shapes, MXU-friendly, no sorting/segment ops that
+would defeat XLA on TPU.
+
+Under ``shard_map`` over ``ep``, the expert weight stacks shard on
+their leading (expert) axis and tokens travel to their expert's owner
+via ``lax.all_to_all`` — the TPU analogue of the all-to-all dispatch in
+GShard/Switch. Without an axis (``ep_axis=None``) the same code runs
+single-device, which doubles as the test oracle.
+
+Capacity-dropped tokens contribute zero from the expert path (the
+caller's residual connection carries them through unchanged) — Switch
+semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_moe_params(
+    rng: jax.Array,
+    hidden: int,
+    ffn: int,
+    num_experts: int,
+    std: float = 0.02,
+) -> Dict[str, jax.Array]:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    trunc = lambda k, shape: (
+        jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32) * std
+    )
+    return {
+        "router_w": trunc(k1, (hidden, num_experts)),
+        "w_in": trunc(k2, (num_experts, hidden, ffn)),
+        "b_in": jnp.zeros((num_experts, ffn), jnp.float32),
+        "w_out": trunc(k3, (num_experts, ffn, hidden)),
+        "b_out": jnp.zeros((num_experts, hidden), jnp.float32),
+    }
+
+
+def moe_pspecs(ep_axis: str = "ep"):
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router_w": P(),
+        "w_in": P(ep_axis),
+        "b_in": P(ep_axis),
+        "w_out": P(ep_axis),
+        "b_out": P(ep_axis),
+    }
+
+
+def moe_ffn(
+    x: jax.Array,
+    params: Dict[str, jax.Array],
+    *,
+    ep_axis: Optional[str] = None,
+    capacity_factor: float = 1.25,
+    compute_dtype=jnp.float32,
+):
+    """Top-1 MoE FFN. x: (..., T, h) flattened to tokens internally.
+
+    Returns (out, aux) where ``out`` has x's shape (zero rows for
+    capacity-dropped tokens — add the residual outside) and ``aux`` is
+    the Switch load-balancing loss (scalar; add to the training loss
+    with a small coefficient, e.g. 0.01).
+    """
+    orig_shape = x.shape
+    h = orig_shape[-1]
+    xt = x.reshape(-1, h)  # (T, h)
+    t = xt.shape[0]
+    e_total = params["router_w"].shape[-1]
+    nep = lax.psum(1, ep_axis) if ep_axis is not None else 1
+    if e_total % nep:
+        raise ValueError(f"experts ({e_total}) not divisible by ep ({nep})")
+
+    logits = jnp.dot(
+        xt.astype(jnp.float32), params["router_w"],
+        preferred_element_type=jnp.float32,
+    )  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    cap = max(1, int(math.ceil(t / e_total * capacity_factor)))
+    onehot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (T, E), -1 elsewhere
+    pos_tok = jnp.sum(pos * onehot, axis=-1)  # (T,)
+    keep = (pos_tok < cap) & (pos_tok >= 0)
+    # dispatch tensor (T, E, C)
+    pos_oh = jax.nn.one_hot(
+        jnp.where(keep, pos_tok, cap).astype(jnp.int32), cap, dtype=jnp.float32
+    )  # (T, C); overflow rows land outside the one-hot range -> zeros
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]  # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+
+    # Switch aux loss: E * sum_e (fraction tokens to e) * (mean prob e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    if ep_axis is not None:
+        frac = lax.pmean(frac, ep_axis)
+        mean_prob = lax.pmean(mean_prob, ep_axis)
+    aux = e_total * jnp.sum(frac * mean_prob)
+
+    expert_in = jnp.einsum(
+        "tec,th->ech", dispatch, xt.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # (E, C, h)
+    if ep_axis is not None:
+        # route token groups to the experts' owners: (E, C, h) ->
+        # (E/n, n*C, h); the local expert dim now matches w_in's shard
+        expert_in = lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+    cdt = compute_dtype
+    y = jax.nn.gelu(
+        jnp.einsum(
+            "ech,ehf->ecf", expert_in.astype(cdt),
+            params["w_in"].astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        + params["b_in"][:, None, :],
+        approximate=True,
+    )
+    y = (
+        jnp.einsum(
+            "ecf,efh->ech", y.astype(cdt), params["w_out"].astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        + params["b_out"][:, None, :]
+    )
+    if ep_axis is not None:
+        y = lax.all_to_all(
+            y, ep_axis, split_axis=1, concat_axis=0, tiled=True
+        )  # back to (E, C, h) token-owner layout
+    out = jnp.einsum(
+        "tec,ech->th", combine, y.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(orig_shape).astype(x.dtype), aux

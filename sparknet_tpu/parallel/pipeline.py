@@ -1,0 +1,253 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over "pp".
+
+No reference counterpart (SURVEY.md §2: data parallelism only). The
+encoder layer stack shards over the ``"pp"`` mesh axis — rank ``r`` owns
+layers ``[r*L/npp, (r+1)*L/npp)`` as *stacked* arrays (leading layer
+axis, ``lax.scan`` inside the stage: one compiled layer body regardless
+of depth). Microbatches march through stages with a neighbor
+``ppermute`` per tick — the classic ``n_micro + npp - 1`` tick schedule
+with bubble ticks at the ends. Embeddings and the MLM head are
+replicated (computed on every rank; only stage 0's embedding output and
+the last stage's loss carry gradients, so the pp-psum of grads is exact,
+not double-counted).
+
+Autodiff runs through the whole schedule: ``ppermute`` transposes to the
+inverse permutation, giving the reverse-order backward pipeline for
+free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..solver.caffe_solver import make_update_fn, mults_for_params
+
+
+def stack_layer_params(params: Dict[str, Dict[str, jax.Array]], num_layers: int):
+    """Split BertMLM params into (stacked_layers, rest): the per-layer
+    dicts become one dict of arrays with a leading layer axis."""
+    layer_keys = [f"layer_{li:02d}" for li in range(num_layers)]
+    names = params[layer_keys[0]].keys()
+    stacked = {
+        n: jnp.stack([params[k][n] for k in layer_keys]) for n in names
+    }
+    rest = {k: v for k, v in params.items() if k not in layer_keys}
+    return stacked, rest
+
+
+def unstack_layer_params(stacked, rest, num_layers: int):
+    out = dict(rest)
+    for li in range(num_layers):
+        out[f"layer_{li:02d}"] = {n: v[li] for n, v in stacked.items()}
+    return out
+
+
+def bert_pp_pspecs(model, pp_axis: str = "pp"):
+    """(stacked_spec, rest_spec): layer stack sharded on its leading
+    axis over pp, everything else replicated."""
+    names = [
+        "q_w", "q_b", "k_w", "k_b", "v_w", "v_b", "out_w", "out_b",
+        "attn_ln_scale", "attn_ln_bias", "ffn_in_w", "ffn_in_b",
+        "ffn_out_w", "ffn_out_b", "ffn_ln_scale", "ffn_ln_bias",
+    ]
+    stacked_spec = {n: P(pp_axis) for n in names}
+    rest_spec = {
+        "embeddings": {
+            "word": P(), "position": P(), "token_type": P(),
+            "ln_scale": P(), "ln_bias": P(),
+        },
+        "mlm_head": {
+            "dense_w": P(), "dense_b": P(), "ln_scale": P(),
+            "ln_bias": P(), "output_bias": P(),
+        },
+    }
+    return stacked_spec, rest_spec
+
+
+def _stage_apply(model, stacked_local, x, kv_mask, rng, train, stage, l_loc,
+                 micro_idx):
+    """Scan this rank's layers over x. rng folds in the *global* layer
+    index (decorrelates across stages) and the microbatch index
+    (decorrelates dropout across microbatches, matching the unpipelined
+    baseline where every batch row draws independent mask values)."""
+
+    def body(carry, layer_params):
+        x, li = carry
+        lrng = None
+        if rng is not None:
+            lrng = jax.random.fold_in(
+                jax.random.fold_in(rng, stage * l_loc + li), micro_idx
+            )
+        y = model.layer_apply(layer_params, x, kv_mask, rng=lrng, train=train)
+        return (y, li + 1), None
+
+    (y, _), _ = lax.scan(body, (x, 0), stacked_local)
+    return y
+
+
+def make_pp_train_step(
+    model,
+    sp,
+    mesh,
+    n_micro: int,
+    dp_axis: Optional[str] = None,
+    pp_axis: str = "pp",
+):
+    """Jitted ``step(params, opt_state, batch, it, rng)`` with the layer
+    stack pipelined over ``pp`` (optionally composed with ``dp``).
+
+    ``params``/``opt_state`` use the *stacked* layout:
+    ``{"layers": stacked, "rest": rest}`` from
+    :func:`stack_layer_params`. ``batch`` is token-level
+    (:func:`sparknet_tpu.data.text.mlm_feed_tokens`); its leading batch
+    dim must divide ``n_micro`` (× dp).
+    """
+    npp = mesh.shape[pp_axis]
+    L = model.cfg.num_layers
+    if L % npp:
+        raise ValueError(f"pp={npp} must divide num_layers ({L})")
+    l_loc = L // npp
+    data_axes = (dp_axis,) if dp_axis else ()
+    stacked_spec, rest_spec = bert_pp_pspecs(model, pp_axis)
+    pspec = {"layers": stacked_spec, "rest": rest_spec}
+
+    # layer lr/decay multipliers, stacked layout: identical per layer
+    l_specs = model.param_specs()["layer_00"]
+    mult_tree = {
+        "layers": {n: l_specs[n][0] for n in stacked_spec},
+        "rest": {
+            k: {n: s[0] for n, s in model.param_specs()[k].items()}
+            for k in ("embeddings", "mlm_head")
+        },
+    }
+    decay_tree = {
+        "layers": {n: l_specs[n][1] for n in stacked_spec},
+        "rest": {
+            k: {n: s[1] for n, s in model.param_specs()[k].items()}
+            for k in ("embeddings", "mlm_head")
+        },
+    }
+
+    def local_step(params, opt_state, batch, it, rng):
+        stage = lax.axis_index(pp_axis)
+        if dp_axis:
+            rng = jax.random.fold_in(rng, lax.axis_index(dp_axis))
+        is_first = stage == 0
+        is_last = stage == npp - 1
+        perm = [(i, i + 1) for i in range(npp - 1)]
+
+        def loss_fn(p):
+            stacked, rest = p["layers"], p["rest"]
+            x0, kv_mask, rng2 = model.embed(
+                rest, batch, train=True, rng=rng
+            )
+            b = x0.shape[0]
+            if b % n_micro:
+                raise ValueError(f"batch {b} not divisible by {n_micro} micro")
+            mb = b // n_micro
+            s, h = x0.shape[1], x0.shape[2]
+            x_micro = x0.reshape(n_micro, mb, s, h)
+            mask_micro = kv_mask.reshape(n_micro, mb, s)
+            ticks = n_micro + npp - 1
+
+            def tick(carry, t):
+                recv, outs = carry
+                mi_in = jnp.clip(t, 0, n_micro - 1)
+                inject = jnp.where(
+                    is_first,
+                    x_micro[mi_in].astype(jnp.float32),
+                    recv.astype(jnp.float32),
+                ).astype(x0.dtype)
+                # each tick, stage s processes microbatch t - s; mask
+                # for that microbatch (clamped during bubbles)
+                mi_here = jnp.clip(t - stage, 0, n_micro - 1)
+                y = _stage_apply(
+                    model, stacked, inject, mask_micro[mi_here], rng2,
+                    True, stage, l_loc, mi_here,
+                )
+                recv_next = lax.ppermute(y, pp_axis, perm)
+                # last stage emits microbatch t - (npp - 1)
+                mi_out = t - (npp - 1)
+                outs = jnp.where(
+                    jnp.logical_and(is_last, mi_out >= 0)[..., None],
+                    lax.dynamic_update_index_in_dim(
+                        outs, y, jnp.clip(mi_out, 0, n_micro - 1), 0
+                    ),
+                    outs,
+                )
+                return (recv_next, outs), None
+
+            outs0 = jnp.zeros((n_micro, mb, s, h), x0.dtype)
+            recv0 = jnp.zeros((mb, s, h), x0.dtype)
+            (_, outs), _ = lax.scan(
+                tick, (recv0, outs0), jnp.arange(ticks)
+            )
+            xf = outs.reshape(b, s, h)
+            nll, w, corr = model.token_loss_from_hidden(
+                rest, xf, batch["mlm_labels"], batch["mlm_weights"]
+            )
+            # only the last stage's head output is real
+            live = is_last.astype(jnp.float32)
+            nll, corr = nll * live, corr * live
+            w_tot = lax.psum(
+                batch["mlm_weights"].astype(jnp.float32).sum(), data_axes
+            ) if data_axes else batch["mlm_weights"].astype(jnp.float32).sum()
+            loss_local = nll / jnp.maximum(w_tot, 1.0)
+            return loss_local, (nll, w_tot, corr)
+
+        grads, (nll, w_tot, corr) = jax.grad(loss_fn, has_aux=True)(params)
+        # pp reduction: replicated leaves ("rest") have grads only on the
+        # stage that used them (embed on 0 unless... actually embed runs
+        # on every rank but only stage 0's output enters the pipeline, so
+        # cotangents vanish elsewhere) -> psum over pp completes them.
+        # stacked layers are pp-sharded: psum over data axes only.
+        grads = {
+            "layers": jax.tree_util.tree_map(
+                (lambda g: lax.psum(g, data_axes)) if data_axes else (lambda g: g),
+                grads["layers"],
+            ),
+            "rest": jax.tree_util.tree_map(
+                lambda g: lax.psum(g, data_axes + (pp_axis,)),
+                grads["rest"],
+            ),
+        }
+        update = make_update_fn(sp, mult_tree, decay_tree)
+        params, opt_state = update(params, grads, opt_state, it)
+        red = lambda z: lax.psum(z, data_axes + (pp_axis,))
+        denom = jnp.maximum(w_tot, 1.0)
+        return params, opt_state, {
+            "loss": red(nll) / denom, "mlm_acc": red(corr) / denom,
+        }
+
+    batch_axes = P(dp_axis) if dp_axis else P()
+    batch_spec = {
+        k: batch_axes
+        for k in (
+            "input_ids", "token_type_ids", "attention_mask",
+            "position_ids", "mlm_labels", "mlm_weights",
+        )
+    }
+    compiled = {}
+
+    def stepper(params, opt_state, batch, it, rng):
+        key = tuple(sorted(opt_state))
+        if key not in compiled:
+            ospec = {k: pspec for k in opt_state}
+            compiled[key] = jax.jit(
+                jax.shard_map(
+                    local_step,
+                    mesh=mesh,
+                    in_specs=(pspec, ospec, batch_spec, P(), P()),
+                    out_specs=(pspec, ospec, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1),
+            )
+        return compiled[key](params, opt_state, batch, it, rng)
+
+    return stepper

@@ -1,0 +1,252 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference is data-parallel only (SURVEY.md §2: "no TP/PP/SP/EP/CP" —
+long-context parallelism is a task-spec obligation, designed TPU-native
+here rather than ported). Both strategies run *inside* ``shard_map``
+over an ``"sp"`` mesh axis, with sequence-sharded q/k/v ``(B, H, S/n,
+D)`` per device:
+
+- :func:`ring_attention` — k/v shards rotate around the ring via
+  ``lax.ppermute`` (ICI neighbor exchange) while each device folds every
+  incoming block into a running online-softmax accumulator ``(o, m, l)``
+  — flash attention's recurrence at shard granularity, so no device ever
+  materialises more than one ``(S/n, S/n)`` logit block. Memory is
+  O(S/n), communication is the bandwidth-optimal ring.
+- :func:`ulysses_attention` — ``lax.all_to_all`` re-shards sequence ->
+  heads, runs *full-sequence* attention locally on H/n heads (the Pallas
+  flash kernel on TPU), then re-shards back. Cheaper compute plumbing
+  when H divides the axis and S fits per-device; ring wins at extreme S.
+
+Both differentiate through the collectives (``ppermute``/``all_to_all``
+have transpose rules), so the same code path trains.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import NEG_INF, attention
+
+
+def _block_logits(q, k, scale, kv_mask_blk, causal, q_off, kv_off):
+    """(B,H,Sq,Sk) masked logits for one ring block."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    sq, sk = q.shape[2], k.shape[2]
+    valid = jnp.ones((1, 1, sq, sk), bool)
+    if causal:
+        qi = jnp.arange(sq)[:, None] + q_off
+        ki = jnp.arange(sk)[None, :] + kv_off
+        valid = valid & (ki <= qi)[None, None]
+    if kv_mask_blk is not None:
+        valid = valid & kv_mask_blk[:, None, None, :].astype(bool)
+    return jnp.where(valid, s, NEG_INF)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Ring attention over sequence shards. Call inside ``shard_map``.
+
+    q/k/v: local shards (B, H, S_local, D); kv_mask: local (B, S_local),
+    True/1 = valid key. Returns the local output shard (B, H, S_local, D).
+
+    Attention-probability dropout drops entries of the *unnormalised*
+    online-softmax numerator p per ring step (keyed by the source shard
+    so the mask is well-defined per (query, key) pair); the denominator
+    keeps the undropped sum, matching the reference path's
+    ``p/sum(p)``-then-drop semantics in expectation.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    q_off = idx * s_loc
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, s_loc), jnp.int32)
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        o, m, l, k_cur, v_cur, mask_cur, src = carry
+        kv_off = src * s_loc
+        s_blk = _block_logits(
+            q, k_cur, scale, mask_cur, causal, q_off, kv_off
+        )
+        m_blk = jnp.max(s_blk, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # guard: rows with nothing valid yet keep exp(NEG_INF-NEG_INF)
+        # from turning into 1
+        p = jnp.where(
+            s_blk <= NEG_INF * 0.5, 0.0, jnp.exp(s_blk - m_new[..., None])
+        )
+        alpha = jnp.where(
+            m <= NEG_INF * 0.5, 0.0, jnp.exp(m - m_new)
+        )
+        l = alpha * l + jnp.sum(p, axis=-1)
+        p_v = p
+        if dropping:
+            # mask keyed by (q shard, kv shard origin), independent of
+            # ring scheduling; numerator-only so l stays the softmax sum
+            blk_rng = jax.random.fold_in(
+                jax.random.fold_in(dropout_rng, idx), src
+            )
+            keep = jax.random.bernoulli(blk_rng, 1.0 - dropout_rate, p.shape)
+            p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p_v, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = lax.ppermute(mask_cur, axis_name, perm)
+        src = (src - 1) % n
+        return (o, m_new, l, k_nxt, v_nxt, mask_nxt, src), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    (o, m, l, *_), _ = lax.scan(
+        step, (o0, m0, l0, k, v, kv_mask, idx), None, length=n
+    )
+    dead = m <= NEG_INF * 0.5
+    out = jnp.where(
+        dead[..., None], 0.0, o / jnp.maximum(l, 1e-30)[..., None]
+    )
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    force: Optional[str] = None,
+) -> jax.Array:
+    """Ulysses SP: all-to-all seq->heads, local full-seq attention
+    (flash on TPU), all-to-all heads->seq. Call inside ``shard_map``.
+
+    Heads must be divisible by the axis size. Attention dropout is
+    delegated to the local attention dispatcher (each rank holds
+    distinct heads, so per-rank rng decorrelation is handled by folding
+    in the axis index).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    if h % n:
+        raise ValueError(f"ulysses: heads ({h}) not divisible by axis ({n})")
+    # (B, H, S/n, D) -> (B, H/n, S, D)
+    a2a = partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
+        tiled=True,
+    )
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    mask_g = (
+        lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+        if kv_mask is not None
+        else None
+    )
+    if dropout_rng is not None:
+        dropout_rng = jax.random.fold_in(dropout_rng, idx)
+    ctx = attention(
+        qg, kg, vg, causal=causal, kv_mask=mask_g, scale=scale,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng, force=force,
+    )
+    # (B, H/n, S, D) -> (B, H, S/n, D)
+    return lax.all_to_all(
+        ctx, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel BERT training step
+# ---------------------------------------------------------------------------
+
+def make_sp_train_step(model, sp, mesh, dp_axis: str = "dp", sp_axis: str = "sp"):
+    """Jitted ``step(params, opt_state, batch, it, rng) -> (params,
+    opt_state, metrics)`` training a token-loss BERT over a 2-D
+    ``(dp, sp)`` mesh: batch rows sharded over ``dp``, sequence sharded
+    over ``sp`` (ring/ulysses attention inside the model), params
+    replicated, gradient all-reduce over both axes.
+
+    ``model`` must be a BertMLM built with ``attention_impl`` in
+    {"ring", "ulysses"}; ``batch`` blobs are (B, S) token-level arrays
+    (``mlm_labels``/``mlm_weights`` per token, plus ``position_ids``).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..solver.caffe_solver import make_update_fn, mults_for_params
+
+    if model.attention_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            "make_sp_train_step needs a model built with attention_impl="
+            f"'ring' or 'ulysses' (got {model.attention_impl!r}) — plain "
+            "attention would silently attend within each shard only"
+        )
+    if model.sp_axis != sp_axis:
+        raise ValueError(
+            f"model.sp_axis ({model.sp_axis!r}) != sp_axis ({sp_axis!r})"
+        )
+
+    def local_step(params, opt_state, batch, it, rng):
+        # decorrelate dropout across mesh positions
+        rng = jax.random.fold_in(rng, lax.axis_index(dp_axis))
+        rng = jax.random.fold_in(rng, lax.axis_index(sp_axis))
+
+        def loss_fn(p):
+            nll, w, corr = model.token_loss_sums(
+                p, {}, batch, train=True, rng=rng
+            )
+            w_tot = lax.psum(w, (dp_axis, sp_axis))
+            loss_local = nll / jnp.maximum(w_tot, 1.0)
+            return loss_local, (nll, w_tot, corr)
+
+        grads, (nll, w_tot, corr) = jax.grad(loss_fn, has_aux=True)(params)
+        grads = lax.psum(grads, (dp_axis, sp_axis))
+        lr_m, dec_m = mults_for_params(params, model.param_specs())
+        update = make_update_fn(sp, lr_m, dec_m)
+        params, opt_state = update(params, grads, opt_state, it)
+        loss = lax.psum(nll, (dp_axis, sp_axis)) / jnp.maximum(w_tot, 1.0)
+        acc = lax.psum(corr, (dp_axis, sp_axis)) / jnp.maximum(w_tot, 1.0)
+        return params, opt_state, {"loss": loss, "mlm_acc": acc}
+
+    batch_spec = {
+        "input_ids": P(dp_axis, sp_axis),
+        "token_type_ids": P(dp_axis, sp_axis),
+        "attention_mask": P(dp_axis, sp_axis),
+        "position_ids": P(dp_axis, sp_axis),
+        "mlm_labels": P(dp_axis, sp_axis),
+        "mlm_weights": P(dp_axis, sp_axis),
+    }
+    step = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_spec, P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=(0, 1))
