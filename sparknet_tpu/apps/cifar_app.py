@@ -40,6 +40,32 @@ def _batch_size(layer, default: int) -> int:
     return default
 
 
+def make_native_feed(
+    ds, transformer: Transformer, batch_size: int, seed: int = 0
+):
+    """Feed served by the C++ prefetching loader (sparknet_tpu.native):
+    shuffle + crop/mirror/mean + batch assembly in native worker threads,
+    Python only memcpys ready batches. Falls back to :func:`make_feed`
+    when the library can't be built."""
+    from .. import native
+
+    if not native.available():
+        return make_feed(ds, transformer, batch_size, seed)
+    parts = [ds.collect_partition(i) for i in range(ds.num_partitions)]
+    images = np.concatenate([p["data"] for p in parts])
+    labels = np.concatenate([p["label"] for p in parts])
+    return native.NativeLoader(
+        images, labels, batch_size,
+        crop=transformer.crop_size,
+        train=transformer.train,
+        mirror=transformer.mirror,
+        mean_image=transformer.mean_image,
+        mean_channel=transformer.mean_values,
+        scale=transformer.scale,
+        seed=seed,
+    )
+
+
 def make_feed(
     ds, transformer: Transformer, batch_size: int, seed: int = 0
 ) -> Iterator[Dict[str, jnp.ndarray]]:
@@ -99,7 +125,10 @@ def build(args) -> tuple:
         solver_dir=solver_dir,
         seed=args.seed,
     )
-    train_feed = make_feed(train_ds, train_tf, train_bs, seed=args.seed)
+    feed_fn = (
+        make_native_feed if getattr(args, "native_loader", False) else make_feed
+    )
+    train_feed = feed_fn(train_ds, train_tf, train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, test_bs, seed=args.seed + 1)
     return solver, train_feed, test_feed
 
@@ -159,6 +188,8 @@ def main(argv=None):
     ap.add_argument("--synthetic-n", type=int, default=10000)
     ap.add_argument("--max-iter", type=int, default=0)
     ap.add_argument("--batch-size", type=int, default=0)
+    ap.add_argument("--native-loader", action="store_true",
+                    help="use the C++ prefetching data loader")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 

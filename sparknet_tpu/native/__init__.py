@@ -1,0 +1,234 @@
+"""ctypes bindings for the native data runtime (libsparknet_data.so).
+
+The reference's JVM↔native boundary is JavaCPP over a C shim
+(SURVEY.md §1-2; mount empty). Ours is ctypes over the same style of C
+ABI — no pybind11 in the image. The library is built on demand with the
+repo's ``native/Makefile`` (g++, baked in); every entry point degrades
+gracefully: ``available()`` is False and callers fall back to the pure
+-Python data path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.abspath(os.path.join(_HERE, "..", "..", "native"))
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsparknet_data.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.sn_version.restype = ctypes.c_int
+        lib.sn_cifar_decode.argtypes = [_u8p, ctypes.c_int, _u8p, _i32p]
+        lib.sn_transform_batch.argtypes = [
+            _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            _f32p, _f32p, ctypes.c_float, _f32p, ctypes.c_int,
+        ]
+        lib.sn_loader_create.restype = ctypes.c_void_p
+        lib.sn_loader_create.argtypes = [
+            _u8p, _i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, _f32p, _f32p, ctypes.c_float, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.sn_loader_next.restype = ctypes.c_int
+        lib.sn_loader_next.argtypes = [ctypes.c_void_p, _f32p, _i32p]
+        lib.sn_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_u8p(a: np.ndarray):
+    return a.ctypes.data_as(_u8p)
+
+
+def _as_f32p(a: Optional[np.ndarray]):
+    return a.ctypes.data_as(_f32p) if a is not None else None
+
+
+def _prep_mean_channel(
+    mean_channel: Optional[np.ndarray], c: int
+) -> Optional[np.ndarray]:
+    """Broadcast to (c,) — Caffe broadcasts a single mean_value to all
+    channels; the C side reads exactly c floats."""
+    if mean_channel is None:
+        return None
+    mc = np.ascontiguousarray(mean_channel, np.float32).reshape(-1)
+    if len(mc) == 1:
+        mc = np.full((c,), mc[0], np.float32)
+    if len(mc) != c:
+        raise ValueError(f"mean_channel has {len(mc)} values for {c} channels")
+    return mc
+
+
+def _check_crop(crop: int, h: int, w: int) -> None:
+    if crop > h or crop > w:
+        raise ValueError(f"crop_size {crop} exceeds image size {h}x{w}")
+
+
+def cifar_decode(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR binary records -> (NHWC uint8 images, int32 labels)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(raw) // 3073
+    buf = np.frombuffer(raw, np.uint8)
+    images = np.empty((n, 32, 32, 3), np.uint8)
+    labels = np.empty((n,), np.int32)
+    lib.sn_cifar_decode(
+        _as_u8p(np.ascontiguousarray(buf)), n, _as_u8p(images),
+        labels.ctypes.data_as(_i32p),
+    )
+    return images, labels
+
+
+def transform_batch(
+    images: np.ndarray,
+    *,
+    crop: int = 0,
+    train: bool = False,
+    mirror: bool = False,
+    seed: int = 0,
+    mean_image: Optional[np.ndarray] = None,
+    mean_channel: Optional[np.ndarray] = None,
+    scale: float = 1.0,
+    num_threads: int = 4,
+) -> np.ndarray:
+    """Native crop/mirror/mean/scale over an NHWC uint8 batch."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    _check_crop(crop, h, w)
+    ch = crop or h
+    cw = crop or w
+    out = np.empty((n, ch, cw, c), np.float32)
+    mi = (
+        np.ascontiguousarray(mean_image, np.float32)
+        if mean_image is not None else None
+    )
+    mc = _prep_mean_channel(mean_channel, c)
+    lib.sn_transform_batch(
+        _as_u8p(images), n, h, w, c, crop, int(train), int(mirror),
+        ctypes.c_uint64(seed), _as_f32p(mi), _as_f32p(mc),
+        ctypes.c_float(scale), out.ctypes.data_as(_f32p), num_threads,
+    )
+    return out
+
+
+class NativeLoader:
+    """Threaded prefetching batch loader over an in-memory dataset.
+
+    Yields {"data": f32 (B, crop, crop, C), "label": int32 (B,)} batches
+    indefinitely (epochs wrap with a fresh deterministic shuffle). The
+    full pipeline — shuffle, crop/mirror/mean, batch assembly — runs in
+    native worker threads ahead of the consumer.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        *,
+        crop: int = 0,
+        train: bool = True,
+        mirror: bool = False,
+        mean_image: Optional[np.ndarray] = None,
+        mean_channel: Optional[np.ndarray] = None,
+        scale: float = 1.0,
+        seed: int = 0,
+        num_threads: int = 2,
+        queue_cap: int = 4,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        images = np.ascontiguousarray(images, np.uint8)
+        labels = np.ascontiguousarray(labels, np.int32)
+        n, h, w, c = images.shape
+        _check_crop(crop, h, w)
+        self.batch_size = batch_size
+        self.shape = (batch_size, crop or h, crop or w, c)
+        mi = (
+            np.ascontiguousarray(mean_image, np.float32)
+            if mean_image is not None else None
+        )
+        mc = _prep_mean_channel(mean_channel, c)
+        self._handle = lib.sn_loader_create(
+            _as_u8p(images), labels.ctypes.data_as(_i32p), n, h, w, c,
+            batch_size, crop, int(train), int(mirror), _as_f32p(mi),
+            _as_f32p(mc), ctypes.c_float(scale), ctypes.c_uint64(seed),
+            num_threads, queue_cap,
+        )
+        if not self._handle:
+            raise ValueError("sn_loader_create failed (check batch <= n)")
+        self.batches_per_epoch = n // batch_size
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        data = np.empty(self.shape, np.float32)
+        labels = np.empty((self.batch_size,), np.int32)
+        rc = self._lib.sn_loader_next(
+            self._handle, data.ctypes.data_as(_f32p),
+            labels.ctypes.data_as(_i32p),
+        )
+        if rc != 0:
+            raise StopIteration
+        return {"data": data, "label": labels}
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.sn_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,148 @@
+"""Native data runtime (C++ via ctypes) vs the pure-Python path."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import native
+from sparknet_tpu.data.cifar import _decode_binary
+from sparknet_tpu.data.preprocess import Transformer
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def test_cifar_decode_matches_python():
+    rng = np.random.default_rng(0)
+    raw = bytes(rng.integers(0, 256, 3073 * 7).astype(np.uint8))
+    ni, nl = native.cifar_decode(raw)
+    pi, pl = _decode_binary(raw)
+    np.testing.assert_array_equal(ni, pi)
+    np.testing.assert_array_equal(nl, pl)
+
+
+def test_transform_center_crop_matches_python():
+    """Deterministic settings (TEST phase): native == Transformer."""
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, (6, 32, 32, 3)).astype(np.uint8)
+    mean = rng.normal(size=(32, 32, 3)).astype(np.float32)
+    t = Transformer(scale=0.5, mean_image=mean, crop_size=28, train=False)
+    ref = t(images, np.random.default_rng(0))
+    out = native.transform_batch(
+        images, crop=28, train=False, mean_image=mean, scale=0.5
+    )
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-5)
+
+
+def test_transform_mean_channel_and_threads():
+    rng = np.random.default_rng(2)
+    images = rng.integers(0, 256, (16, 8, 8, 3)).astype(np.uint8)
+    mc = np.array([104.0, 117.0, 123.0], np.float32)
+    a = native.transform_batch(images, mean_channel=mc, num_threads=1)
+    b = native.transform_batch(images, mean_channel=mc, num_threads=8)
+    np.testing.assert_array_equal(a, b)  # thread count can't change output
+    np.testing.assert_allclose(
+        a, images.astype(np.float32) - mc, rtol=1e-6
+    )
+
+
+def test_transform_train_crop_in_bounds_and_seed_deterministic():
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 256, (32, 16, 16, 3)).astype(np.uint8)
+    a = native.transform_batch(images, crop=8, train=True, mirror=True, seed=7)
+    b = native.transform_batch(images, crop=8, train=True, mirror=True, seed=7)
+    c = native.transform_batch(images, crop=8, train=True, mirror=True, seed=8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)  # different seed, different crops
+    assert a.shape == (32, 8, 8, 3)
+
+
+def test_loader_epoch_coverage_and_determinism():
+    """One epoch visits each sample at most once (Feistel shuffle is a
+    permutation); two loaders with the same seed produce identical
+    streams regardless of thread count."""
+    n = 64
+    rng = np.random.default_rng(4)
+    images = rng.integers(0, 256, (n, 8, 8, 3)).astype(np.uint8)
+    labels = np.arange(n, dtype=np.int32)  # label == sample id
+
+    def stream(threads):
+        ld = native.NativeLoader(
+            images, labels, batch_size=8, train=False, seed=5,
+            num_threads=threads,
+        )
+        try:
+            return [next(ld) for _ in range(16)]  # 2 epochs
+        finally:
+            ld.close()
+
+    s1, s2 = stream(1), (stream(4))
+    for b1, b2 in zip(s1, s2):
+        np.testing.assert_array_equal(b1["label"], b2["label"])
+        np.testing.assert_array_equal(b1["data"], b2["data"])
+    # epoch 0 = batches 0..7: every sample exactly once
+    seen = np.concatenate([b["label"] for b in s1[:8]])
+    assert sorted(seen.tolist()) == list(range(n))
+    # epoch 1 differs in order from epoch 0
+    seen2 = np.concatenate([b["label"] for b in s1[8:]])
+    assert sorted(seen2.tolist()) == list(range(n))
+    assert seen.tolist() != seen2.tolist()
+
+
+def test_loader_transform_matches_native_transform():
+    """Loader batches equal sn_transform_batch on the same permuted rows
+    (data path consistency), including mean subtraction."""
+    n = 32
+    rng = np.random.default_rng(6)
+    images = rng.integers(0, 256, (n, 12, 12, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.int32)
+    mc = np.array([10.0, 20.0, 30.0], np.float32)
+    ld = native.NativeLoader(
+        images, labels, batch_size=4, crop=8, train=False,
+        mean_channel=mc, scale=0.25, seed=9, num_threads=2,
+    )
+    try:
+        batch = next(ld)
+    finally:
+        ld.close()
+    # reconstruct: which source rows were batch 0? labels identify them
+    # only statistically; instead just verify value semantics on one row
+    # by matching against all candidate source rows
+    cand = native.transform_batch(
+        images, crop=8, train=False, mean_channel=mc, scale=0.25
+    )
+    for row in batch["data"]:
+        assert any(
+            np.allclose(row, cand[j], atol=1e-5) for j in range(n)
+        )
+
+
+def test_loader_rejects_batch_larger_than_dataset():
+    images = np.zeros((4, 8, 8, 3), np.uint8)
+    labels = np.zeros((4,), np.int32)
+    with pytest.raises(ValueError):
+        native.NativeLoader(images, labels, batch_size=8)
+
+
+def test_transform_both_means_and_scalar_mean_value():
+    """Both mean_image and mean_channel subtract (preprocess.py parity);
+    a single mean_value broadcasts to all channels."""
+    rng = np.random.default_rng(7)
+    images = rng.integers(0, 256, (3, 8, 8, 3)).astype(np.uint8)
+    mean_img = rng.normal(size=(8, 8, 3)).astype(np.float32)
+    mc1 = np.array([50.0], np.float32)  # scalar mean_value
+    t = Transformer(scale=2.0, mean_image=mean_img, mean_values=mc1,
+                    train=False)
+    ref = t(images, np.random.default_rng(0))
+    out = native.transform_batch(
+        images, train=False, mean_image=mean_img, mean_channel=mc1, scale=2.0
+    )
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
+def test_crop_larger_than_image_raises():
+    images = np.zeros((2, 8, 8, 3), np.uint8)
+    with pytest.raises(ValueError):
+        native.transform_batch(images, crop=16)
+    with pytest.raises(ValueError):
+        native.NativeLoader(images, np.zeros(2, np.int32), 1, crop=16)
