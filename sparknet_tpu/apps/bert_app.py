@@ -114,6 +114,11 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--tau", type=int, default=10)
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--attention", choices=("flash", "reference"), default=None)
+    ap.add_argument("--snapshot", type=int, default=0,
+                    help="snapshot solver state every N iters")
+    ap.add_argument("--snapshot-prefix", default="bert")
+    ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
+                    help="resume from a .solverstate.npz snapshot")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -121,6 +126,10 @@ def parser() -> argparse.ArgumentParser:
 def main(argv=None) -> Dict[str, float]:
     args = parser().parse_args(argv)
     solver, feed, cfg = build(args)
+    if args.restore:
+        solver.restore(args.restore, feed)
+        print(f"Restoring previous solver status from {args.restore} "
+              f"(iter {solver.iter})")
     n_params = solver.train_net.num_params(solver.params)
     print(
         f"BertApp: config={args.config} vocab={cfg.vocab_size} "
@@ -129,15 +138,26 @@ def main(argv=None) -> Dict[str, float]:
     t0 = time.time()
     metrics = {}
     while solver.iter < args.max_iter:
-        n = min(args.display or 20, args.max_iter - solver.iter)
+        # stop at the nearest of: next display chunk, next snapshot
+        # boundary, max_iter — so the cadences can't skip each other
+        # (same scheme as cifar_app.train_loop).
+        targets = [args.max_iter]
+        for interval in (args.display or 20, args.snapshot):
+            if interval:
+                targets.append((solver.iter // interval + 1) * interval)
         m = solver.step(
-            feed, n,
+            feed, min(targets) - solver.iter,
             log_fn=lambda it, mm: print(
                 f"Iteration {it}, loss = {mm['loss']:.5f}, "
                 f"mlm_acc = {mm['mlm_acc']:.4f}"
             ),
         )
         metrics = {k: float(v) for k, v in m.items()}
+        at_end = solver.iter >= args.max_iter
+        if args.snapshot and (solver.iter % args.snapshot == 0 or at_end):
+            path = f"{args.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
+            solver.save(path)
+            print(f"Snapshotting solver state to {path}")
     dt = time.time() - t0
     print(
         f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "

@@ -164,7 +164,10 @@ def train_loop(solver: Solver, train_feed, test_feed, log=print) -> Dict[str, fl
         ):
             path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
             W.save_npz(path, solver.params)
+            state_path = f"{sp.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
+            solver.save(state_path)
             log(f"Snapshotting to {path}")
+            log(f"Snapshotting solver state to {state_path}")
     dt = time.time() - t0
     log(
         f"Optimization Done. {sp.max_iter} iters in {dt:.1f}s "
@@ -190,10 +193,16 @@ def main(argv=None):
     ap.add_argument("--batch-size", type=int, default=0)
     ap.add_argument("--native-loader", action="store_true",
                     help="use the C++ prefetching data loader")
+    ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
+                    help="resume from a .solverstate.npz snapshot")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
     solver, train_feed, test_feed = build(args)
+    if args.restore:
+        solver.restore(args.restore, train_feed)
+        print(f"Restoring previous solver status from {args.restore} "
+              f"(iter {solver.iter})")
     print(
         f"CifarApp: net={solver.net_param.name} params="
         f"{W.num_params(solver.params)} max_iter={solver.sp.max_iter}"

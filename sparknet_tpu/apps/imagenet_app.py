@@ -136,6 +136,8 @@ def parser() -> argparse.ArgumentParser:
                     help="local-SGD sync period (the SparkNet τ knob)")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (TPU-native matmul dtype)")
+    ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
+                    help="resume from a .solverstate.npz snapshot")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -143,6 +145,10 @@ def parser() -> argparse.ArgumentParser:
 def main(argv=None):
     args = parser().parse_args(argv)
     solver, train_feed, test_feed = build(args)
+    if args.restore:
+        solver.restore(args.restore, train_feed)
+        print(f"Restoring previous solver status from {args.restore} "
+              f"(iter {solver.iter})")
     print(
         f"ImageNetApp: net={solver.net_param.name} "
         f"params={W.num_params(solver.params)} max_iter={solver.sp.max_iter}"

@@ -88,6 +88,20 @@ class ParallelSolver(Solver):
             raise ValueError(f"mode {mode!r} (want 'sync' or 'local')")
 
     # ------------------------------------------------------------------
+    def _place_restored(self, params, state, opt_state):
+        params = replicate(params, self.mesh)
+        state = replicate(state, self.mesh)
+        if self.mode == "sync":
+            opt_state = replicate(opt_state, self.mesh)
+        else:  # local: per-dp-slice optimizer slots, sharded on dp
+            opt_state = jax.device_put(
+                opt_state,
+                jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
+                ),
+            )
+        return params, state, opt_state
+
     def _round_fn(self, tau: int):
         if tau not in self._rounds:
             self._rounds[tau] = make_local_sgd_round(

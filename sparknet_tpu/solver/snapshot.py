@@ -1,0 +1,76 @@
+"""Solver snapshot/restore — Caffe ``.solverstate`` parity.
+
+Caffe snapshots two artifacts per boundary: the weights alone
+(``.caffemodel``) and the full solver state (``.solverstate``) holding
+the optimizer history and iteration so training resumes exactly where
+it stopped (SURVEY.md §5 checkpointing; mount empty, no file:line).
+Our ``.solverstate.npz`` holds params, net state (e.g. BatchNorm
+statistics), every optimizer slot, the iteration counter and the
+solver's PRNG key; the pytree structure rides along as one JSON entry,
+so restore needs no model to reconstruct shapes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+FORMAT_VERSION = 1
+_META_KEY = "__solverstate__"
+
+
+def _encode(obj: Any, leaves: list) -> Any:
+    if isinstance(obj, dict):
+        return {"t": "dict", "k": {str(k): _encode(v, leaves) for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        return {
+            "t": "tuple" if isinstance(obj, tuple) else "list",
+            "v": [_encode(v, leaves) for v in obj],
+        }
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, (bool, int, float, str)):
+        return {"t": "py", "v": obj}
+    leaves.append(np.asarray(obj))
+    return {"t": "leaf", "i": len(leaves) - 1}
+
+
+def _decode(spec: Any, leaves: Dict[str, np.ndarray]) -> Any:
+    t = spec["t"]
+    if t == "dict":
+        return {k: _decode(v, leaves) for k, v in spec["k"].items()}
+    if t in ("list", "tuple"):
+        vals = [_decode(v, leaves) for v in spec["v"]]
+        return tuple(vals) if t == "tuple" else vals
+    if t == "none":
+        return None
+    if t == "py":
+        return spec["v"]
+    return leaves[f"a{spec['i']}"]
+
+
+def save_state(path: str, **trees: Any) -> None:
+    """Write named pytrees (nested dict/list/tuple of arrays and Python
+    scalars) to one npz. Device arrays are pulled to host."""
+    leaves: list = []
+    structure = {name: _encode(tree, leaves) for name, tree in trees.items()}
+    meta = json.dumps({"version": FORMAT_VERSION, "structure": structure})
+    arrays = {f"a{i}": leaf for i, leaf in enumerate(leaves)}
+    np.savez(path, **arrays, **{_META_KEY: np.frombuffer(meta.encode(), np.uint8)})
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    """Inverse of :func:`save_state`; leaves come back as host numpy."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        if meta["version"] != FORMAT_VERSION:
+            raise ValueError(
+                f"solverstate version {meta['version']} != {FORMAT_VERSION}"
+            )
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    return {
+        name: _decode(spec, arrays)
+        for name, spec in meta["structure"].items()
+    }

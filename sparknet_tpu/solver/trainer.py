@@ -200,6 +200,57 @@ class Solver:
                 log_fn(self.iter, {k: float(v) for k, v in metrics.items()})
         return metrics
 
+    # -- snapshot / restore (Caffe .solverstate parity) ------------------
+    def save(self, path: str) -> None:
+        """Full solver state: params + net state (BN stats) + optimizer
+        slots + iteration + PRNG key — enough to resume bit-identically
+        (Caffe's ``.solverstate``, SURVEY.md §5)."""
+        from . import snapshot
+
+        snapshot.save_state(
+            path,
+            params=self.params,
+            state=self.state,
+            opt_state=self.opt_state,
+            it=self.iter,
+            rng=self.rng,
+        )
+
+    def restore(self, path: str, feed=None) -> None:
+        """Load a ``.solverstate.npz``; with ``feed`` given, also align
+        the data stream (see :meth:`align_feed`)."""
+        from . import snapshot
+
+        st = snapshot.load_state(path)
+        self.iter = int(st["it"])
+        self.rng = jnp.asarray(st["rng"])
+        self.params, self.state, self.opt_state = self._place_restored(
+            st["params"], st["state"], st["opt_state"]
+        )
+        if feed is not None:
+            self.align_feed(feed)
+
+    def align_feed(self, feed) -> None:
+        """Advance a deterministic (seeded) feed past the batches a
+        restored run already consumed, so resume is bit-identical to the
+        uninterrupted run. (Caffe restarts its DB cursor on resume; a
+        seeded ShardedDataset feed lets us do better.)  Feeds exposing a
+        ``skip(n)`` method get an O(1) fast-forward; plain generators
+        replay (and pay for) the skipped host preprocessing."""
+        n = self.iter * max(1, self.sp.iter_size)
+        skip = getattr(feed, "skip", None)
+        if skip is not None:
+            skip(n)
+        else:
+            for _ in range(n):
+                next(feed)
+
+    def _place_restored(self, params, state, opt_state):
+        """Device placement for restored host trees; ParallelSolver
+        overrides to re-apply mesh shardings."""
+        to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        return to_dev(params), to_dev(state), to_dev(opt_state)
+
     def test(self, batches: Iterator[Dict[str, Any]], test_iter: Optional[int] = None):
         n = test_iter or (self.sp.test_iter[0] if self.sp.test_iter else 1)
         acc: Dict[str, float] = {}

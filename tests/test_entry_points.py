@@ -1,0 +1,79 @@
+"""Driver-facing entry points must never regress (VERDICT r1/r2 #1).
+
+MULTICHIP_r01/r02 both failed because ``dryrun_multichip`` assumed the
+driver environment provided 8 devices. These tests import and execute
+the exact artifacts the driver runs — ``__graft_entry__.entry``,
+``__graft_entry__.dryrun_multichip`` and ``bench.main`` — so any
+regression fails CI before it can cost a round.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import jax
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    loss = jax.jit(fn)(*args)
+    assert np.isfinite(float(loss))
+
+
+def test_dryrun_multichip_all_axes():
+    # conftest already forced the 8-device CPU mesh; _ensure_devices must
+    # detect that and no-op. In the driver's process (1 axon device) it
+    # must instead force the virtual mesh itself.
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_ensure_devices_is_idempotent():
+    import __graft_entry__ as ge
+
+    ge._ensure_devices(8)
+    assert len(jax.devices()) >= 8
+
+
+def _run_bench(capsys):
+    import bench
+
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def test_bench_alexnet_emits_json(monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_BATCH", "4")
+    monkeypatch.setenv("BENCH_ITERS", "2")
+    rec = _run_bench(capsys)
+    assert rec["metric"] == "alexnet_train_images_per_sec_per_chip"
+    assert rec["value"] > 0 and "error" not in rec
+    assert rec["platform"] == "cpu"
+    assert rec["tflops"] > 0
+
+
+def test_bench_alexnet_input_pipeline_mode(monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_BATCH", "4")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    monkeypatch.setenv("BENCH_INPUT_PIPELINE", "1")
+    rec = _run_bench(capsys)
+    assert rec["value"] > 0 and rec["input_pipeline"] is True
+
+
+def test_bench_bert_emits_json(monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_MODEL", "bert")
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    monkeypatch.setenv("BENCH_SEQ", "64")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    rec = _run_bench(capsys)
+    assert rec["metric"] == "bert_base_mlm_tokens_per_sec_per_chip"
+    assert rec["value"] > 0 and "error" not in rec

@@ -1,0 +1,129 @@
+"""Checkpoint/resume: Caffe ``.solverstate`` parity (SURVEY.md §5).
+
+The contract: save at iteration k, restore into a FRESH solver, feed the
+same batches — every parameter, optimizer slot, and metric must be
+bit-identical to the uninterrupted run.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.solver import snapshot
+from sparknet_tpu.solver.trainer import Solver
+from sparknet_tpu.parallel import ParallelSolver, make_mesh
+
+REPO = Path(__file__).resolve().parents[1]
+ZOO = REPO / "sparknet_tpu" / "models" / "prototxt"
+
+
+def test_save_state_round_trip(tmp_path):
+    tree = {
+        "a": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        "b": [np.ones(2, np.int32), (np.zeros(1), None)],
+        "c": {"nested": {"deep": np.float64(3.5)}},
+    }
+    path = str(tmp_path / "st.npz")
+    snapshot.save_state(path, tree=tree, it=42, scalar=1.5, name="x")
+    out = snapshot.load_state(path)
+    assert out["it"] == 42 and out["scalar"] == 1.5 and out["name"] == "x"
+    np.testing.assert_array_equal(out["tree"]["a"]["w"], tree["a"]["w"])
+    np.testing.assert_array_equal(out["tree"]["b"][0], tree["b"][0])
+    assert isinstance(out["tree"]["b"][1], tuple)
+    assert out["tree"]["b"][1][1] is None
+    np.testing.assert_array_equal(
+        out["tree"]["c"]["nested"]["deep"], tree["c"]["nested"]["deep"]
+    )
+
+
+def _batches(n, bs=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "data": jnp.asarray(rng.normal(size=(bs, 32, 32, 3)), jnp.float32),
+            "label": jnp.asarray(rng.integers(0, 10, bs), jnp.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+def _make_cifar_solver(parallel=None, tau=1, bs=8):
+    sp = caffe_pb.load_solver(str(ZOO / "cifar10_quick_solver.prototxt"))
+    sp.base_lr = 0.01
+    shapes = {"data": (bs, 32, 32, 3), "label": (bs,)}
+    if parallel is None:
+        return Solver(sp, shapes, solver_dir=str(REPO))
+    return ParallelSolver(
+        sp, shapes, solver_dir=str(REPO),
+        mesh=make_mesh({"dp": 2}, jax.devices()[:2]), mode=parallel, tau=tau,
+    )
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves_with_path(b)
+    assert len(la) == len(lb)
+    for (pa, xa), (pb, xb) in zip(la, lb):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb),
+                                      err_msg=str(pa))
+
+
+@pytest.mark.parametrize("mode,tau", [(None, 1), ("sync", 1), ("local", 2)])
+def test_resume_is_bit_identical(tmp_path, mode, tau):
+    batches = _batches(8, seed=5)
+    path = str(tmp_path / "ck.solverstate.npz")
+
+    # uninterrupted run: 4 + 4
+    s1 = _make_cifar_solver(mode, tau)
+    s1.step(iter(batches[:4]), 4)
+    s1.save(path)
+    s1.step(iter(batches[4:]), 4)
+
+    # fresh solver, restored mid-run, fed the same tail
+    s2 = _make_cifar_solver(mode, tau)
+    s2.restore(path)
+    assert s2.iter == 4
+    s2.step(iter(batches[4:]), 4)
+
+    assert s2.iter == s1.iter
+    _assert_trees_equal(s1.params, s2.params)
+    _assert_trees_equal(s1.opt_state, s2.opt_state)
+    _assert_trees_equal(s1.state, s2.state)
+    np.testing.assert_array_equal(np.asarray(s1.rng), np.asarray(s2.rng))
+
+
+def test_cifar_app_restore_cli(tmp_path):
+    """The CifarApp --restore flag end-to-end: snapshot at iter 2, resume
+    to 4, matching the uninterrupted params exactly."""
+    from sparknet_tpu.apps import cifar_app
+
+    prefix = str(tmp_path / "snap")
+    common = [
+        "--synthetic", "--synthetic-n", "1000", "--batch-size", "8",
+        "--seed", "7",
+    ]
+
+    def run(extra):
+        import sys
+
+        solver_txt = tmp_path / "solver.prototxt"
+        base = (ZOO / "cifar10_quick_solver.prototxt").read_text()
+        base += f"\nsnapshot: 2\nsnapshot_prefix: \"{prefix}\"\n"
+        solver_txt.write_text(base)
+        return cifar_app.main(
+            ["--solver", str(solver_txt), "--max-iter", "4"] + common + extra
+        )
+
+    run([])  # writes snap_iter_2.solverstate.npz and snap_iter_4...
+    import sparknet_tpu.nets.weights as W
+
+    p_full = W.load_npz(f"{prefix}_iter_4.npz")
+    # wipe the iter-4 artifacts, resume from iter 2
+    run(["--restore", f"{prefix}_iter_2.solverstate.npz"])
+    p_resumed = W.load_npz(f"{prefix}_iter_4.npz")
+    _assert_trees_equal(p_full, p_resumed)
