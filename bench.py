@@ -39,28 +39,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-CAFFE_K40_ALEXNET_IMG_PER_SEC = 250.0  # "4 ms/image for learning"
+from sparknet_tpu.utils.profiling import compiled_flops, device_peak_flops
 
-# bf16 peak TFLOP/s per chip by device_kind substring (order matters:
-# more specific first). Sources: public TPU spec sheets.
-_PEAK_TFLOPS = [
-    ("v6 lite", 918e12),
-    ("v6e", 918e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+CAFFE_K40_ALEXNET_IMG_PER_SEC = 250.0  # "4 ms/image for learning"
 
 
 def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _PEAK_TFLOPS:
-        if key in kind:
-            return peak
-    return None
+    return device_peak_flops(device)
 
 
 def _first_device():
@@ -75,22 +60,15 @@ def _first_device():
 def _step_flops(solver, batch) -> float | None:
     """Actual per-step FLOPs of the compiled train step (fwd+bwd+update)
     from XLA cost analysis; None if the backend doesn't report it."""
-    try:
-        lowered = solver._train_step.lower(
-            solver.params,
-            solver.state,
-            solver.opt_state,
-            batch,
-            jnp.asarray(0, jnp.int32),
-            jax.random.PRNGKey(0),
-        )
-        cost = lowered.compile().cost_analysis()
-        if isinstance(cost, list):  # older jax returns [dict]
-            cost = cost[0]
-        f = float(cost.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception:
-        return None
+    return compiled_flops(
+        solver._train_step,
+        solver.params,
+        solver.state,
+        solver.opt_state,
+        batch,
+        jnp.asarray(0, jnp.int32),
+        jax.random.PRNGKey(0),
+    )
 
 
 # Analytic fallbacks: training ~= 3x forward FLOPs.

@@ -13,12 +13,13 @@ import argparse
 import time
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..data.text import mlm_dataset, mlm_feed
 from ..models.bert import BertConfig, BertMLM
-from ..parallel import ParallelSolver, make_mesh
+from ..parallel import ParallelSolver, make_mesh, multihost
 from ..proto import caffe_pb
 from ..solver.trainer import Solver
 
@@ -75,6 +76,17 @@ def build(args):
     if vsize != cfg.vocab_size:  # corpus-built vocab may be smaller
         cfg = type(cfg)(**{**cfg.__dict__, "vocab_size": vsize})
 
+    # multi-host: host-sharded data, local feed rows, global solver batch
+    nproc = jax.process_count()
+    feed_bs = bs
+    if nproc > 1:
+        if args.parallel == "none":
+            raise ValueError("multi-host launch requires --parallel sync|local")
+        if bs % nproc:
+            raise ValueError(f"batch ({bs}) must divide across {nproc} processes")
+        ds = multihost.host_shard(ds)
+        feed_bs = bs // nproc
+
     shapes = {
         "input_ids": (bs, seq),
         "mlm_positions": (bs, max_preds),
@@ -93,7 +105,7 @@ def build(args):
             sp, shapes, model=model, seed=args.seed,
             mesh=make_mesh(), mode=args.parallel, tau=args.tau,
         )
-    feed = mlm_feed(ds, bs, cfg.vocab_size, max_preds, seed=args.seed)
+    feed = mlm_feed(ds, feed_bs, cfg.vocab_size, max_preds, seed=args.seed)
     return solver, feed, cfg
 
 
@@ -119,24 +131,38 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--snapshot-prefix", default="bert")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
+    ap.add_argument("--profile-dir", default=None,
+                    help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
 
 def main(argv=None) -> Dict[str, float]:
     args = parser().parse_args(argv)
+    multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, feed, cfg = build(args)
     if args.restore:
         solver.restore(args.restore, feed)
-        print(f"Restoring previous solver status from {args.restore} "
-              f"(iter {solver.iter})")
-    n_params = solver.train_net.num_params(solver.params)
-    print(
-        f"BertApp: config={args.config} vocab={cfg.vocab_size} "
-        f"layers={cfg.num_layers} hidden={cfg.hidden_size} params={n_params}"
+    primary = multihost.is_primary()
+    if primary:
+        if args.restore:
+            print(f"Restoring previous solver status from {args.restore} "
+                  f"(iter {solver.iter})")
+        n_params = solver.train_net.num_params(solver.params)
+        print(
+            f"BertApp: config={args.config} vocab={cfg.vocab_size} "
+            f"layers={cfg.num_layers} hidden={cfg.hidden_size} params={n_params}"
+        )
+    from ..utils.profiling import StepTimer, trace
+
+    timer = StepTimer(
+        items_per_step=args.batch_size * solver.train_net.seq_len,
+        unit="tokens",
     )
     t0 = time.time()
     metrics = {}
+    profiler = trace(args.profile_dir)
+    profiler.__enter__()
     while solver.iter < args.max_iter:
         # stop at the nearest of: next display chunk, next snapshot
         # boundary, max_iter — so the cadences can't skip each other
@@ -145,24 +171,33 @@ def main(argv=None) -> Dict[str, float]:
         for interval in (args.display or 20, args.snapshot):
             if interval:
                 targets.append((solver.iter // interval + 1) * interval)
+        prev_iter = solver.iter
         m = solver.step(
             feed, min(targets) - solver.iter,
-            log_fn=lambda it, mm: print(
+            log_fn=lambda it, mm: primary and print(
                 f"Iteration {it}, loss = {mm['loss']:.5f}, "
                 f"mlm_acc = {mm['mlm_acc']:.4f}"
             ),
         )
-        metrics = {k: float(v) for k, v in m.items()}
+        metrics = {k: float(v) for k, v in m.items()}  # host sync
+        if primary and args.display:
+            print(f"    speed: {timer.update(solver.iter - prev_iter).format()}")
         at_end = solver.iter >= args.max_iter
-        if args.snapshot and (solver.iter % args.snapshot == 0 or at_end):
+        if (
+            args.snapshot
+            and primary
+            and (solver.iter % args.snapshot == 0 or at_end)
+        ):
             path = f"{args.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
             solver.save(path)
             print(f"Snapshotting solver state to {path}")
+    profiler.__exit__(None, None, None)
     dt = time.time() - t0
-    print(
-        f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
-        f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
-    )
+    if primary:
+        print(
+            f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
+            f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
+        )
     return metrics
 
 

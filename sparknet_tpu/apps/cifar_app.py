@@ -15,14 +15,28 @@ import os
 import time
 from typing import Dict, Iterator, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..data.cifar import cifar10_dataset
 from ..data.preprocess import Transformer
 from ..nets import weights as W
+from ..parallel import ParallelSolver, make_mesh, multihost
 from ..proto import caffe_pb
 from ..solver.trainer import Solver, resolve_model_path
+
+
+def _dataset_mean(ds) -> np.ndarray:
+    """Per-pixel mean over a dataset's "data" rows — Caffe's
+    compute_image_mean, regenerated when the .binaryproto is absent."""
+    total = None
+    count = 0
+    for i in range(ds.num_partitions):
+        part = ds.collect_partition(i)["data"].astype(np.float64)
+        total = part.sum(0) if total is None else total + part.sum(0)
+        count += len(part)
+    return (total / max(count, 1)).astype(np.float32)
 
 
 def _data_layer(net: caffe_pb.NetParameter, phase: str):
@@ -96,17 +110,55 @@ def build(args) -> tuple:
     test_bs = _batch_size(test_layer, train_bs)
 
     data_dir = None if args.synthetic else args.data_dir
-    train_ds, mean = cifar10_dataset(data_dir, train=True, synthetic_n=args.synthetic_n)
-    test_ds, _ = cifar10_dataset(data_dir, train=False, synthetic_n=args.synthetic_n)
+    # Caffe-native sources (LMDB/ImageData/HDF5) referenced by the
+    # prototxt win when present on disk — full data_param fidelity
+    mean = None
+    train_ds = test_ds = None
+    if not args.synthetic:
+        from ..data.caffe_layers import dataset_from_layer
+
+        train_ds = dataset_from_layer(train_layer, solver_dir)
+        test_ds = dataset_from_layer(test_layer, solver_dir)
+    if train_ds is None:
+        train_ds, mean = cifar10_dataset(
+            data_dir, train=True, synthetic_n=args.synthetic_n
+        )
+    if test_ds is None:
+        test_ds, _ = cifar10_dataset(
+            data_dir, train=False, synthetic_n=args.synthetic_n
+        )
+
+    # multi-host: each process feeds its shard; batch sizes in the
+    # solver stay GLOBAL (prototxt semantics), feeds serve local rows
+    nproc = jax.process_count()
+    feed_train_bs, feed_test_bs = train_bs, test_bs
+    if nproc > 1:
+        if train_bs % nproc or test_bs % nproc:
+            raise ValueError(
+                f"batch sizes ({train_bs}/{test_bs}) must divide across "
+                f"{nproc} processes"
+            )
+        train_ds = multihost.host_shard(train_ds)
+        test_ds = multihost.host_shard(test_ds)
+        feed_train_bs, feed_test_bs = train_bs // nproc, test_bs // nproc
 
     def transformer_for(layer, train: bool) -> Transformer:
         t = Transformer.from_message(
             layer.transform_param if layer else None, train=train
         )
-        # mean_file in the prototxt -> per-pixel mean computed from data
         tp = layer.transform_param if layer else None
         if tp is not None and tp.get("mean_file") is not None:
-            t.mean_image = mean
+            # a real .binaryproto wins; otherwise recompute from data
+            # (Caffe's compute_image_mean output, regenerated)
+            mf = resolve_model_path(str(tp.get("mean_file")), solver_dir)
+            if os.path.exists(mf):
+                from ..proto.caffemodel import load_binaryproto_mean
+
+                t.mean_image = load_binaryproto_mean(mf)
+            else:
+                t.mean_image = (
+                    mean if mean is not None else _dataset_mean(train_ds)
+                )
         return t
 
     train_tf = transformer_for(train_layer, True)
@@ -117,24 +169,49 @@ def build(args) -> tuple:
     test_crop = test_tf.crop_size or 32
     test_shapes = {"data": (test_bs, test_crop, test_crop, 3), "label": (test_bs,)}
 
-    solver = Solver(
-        sp,
-        shapes,
+    kw = dict(
         test_input_shapes=test_shapes,
         net_param=net_param,
         solver_dir=solver_dir,
         seed=args.seed,
     )
+    parallel = getattr(args, "parallel", "none")
+    if parallel == "none":
+        if nproc > 1:
+            raise ValueError("multi-host launch requires --parallel sync|local")
+        solver = Solver(sp, shapes, **kw)
+    else:
+        solver = ParallelSolver(
+            sp, shapes, mesh=make_mesh(), mode=parallel,
+            tau=getattr(args, "tau", 1), **kw
+        )
+    if getattr(args, "weights", None):
+        solver.load_weights(args.weights)  # Caffe --weights finetuning
     feed_fn = (
         make_native_feed if getattr(args, "native_loader", False) else make_feed
     )
-    train_feed = feed_fn(train_ds, train_tf, train_bs, seed=args.seed)
-    test_feed = make_feed(test_ds, test_tf, test_bs, seed=args.seed + 1)
+    train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
+    test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     return solver, train_feed, test_feed
 
 
-def train_loop(solver: Solver, train_feed, test_feed, log=print) -> Dict[str, float]:
+def train_loop(
+    solver: Solver, train_feed, test_feed, log=print, timer=None
+) -> Dict[str, float]:
+    from ..utils.profiling import StepTimer
+
     sp = solver.sp
+    if not multihost.is_primary():
+        # every process computes (collectives are SPMD); only process 0
+        # speaks and writes — the reference's driver-side duties
+        log = lambda *a, **k: None
+    if timer is None:
+        shapes = solver.train_net.blob_shapes
+        data_name = "data" if "data" in shapes else next(iter(shapes), None)
+        timer = StepTimer(
+            items_per_step=shapes[data_name][0] if data_name else 0,
+            unit="images",
+        )
     t0 = time.time()
     last_test: Dict[str, float] = {}
     while solver.iter < sp.max_iter:
@@ -145,13 +222,20 @@ def train_loop(solver: Solver, train_feed, test_feed, log=print) -> Dict[str, fl
             if interval:
                 targets.append((solver.iter // interval + 1) * interval)
         nxt = min(targets)
-        solver.step(
+        prev_iter = solver.iter
+        timer.update(0)  # reset the window to exclude eval/snapshot time
+        m = solver.step(
             train_feed,
             nxt - solver.iter,
-            log_fn=lambda it, m: log(
-                f"Iteration {it}, loss = {m.get('loss', float('nan')):.5f}"
+            log_fn=lambda it, mm: log(
+                f"Iteration {it}, loss = {mm.get('loss', float('nan')):.5f}"
             ),
         )
+        if sp.display:
+            if m:  # host sync so the window measures completed compute
+                jax.block_until_ready(next(iter(m.values())))
+            timer.update(solver.iter - prev_iter)
+            log(f"    speed: {timer.format()}")
         at_end = solver.iter >= sp.max_iter
         if (sp.test_interval and solver.iter % sp.test_interval == 0) or at_end:
             last_test = solver.test(test_feed)
@@ -160,6 +244,7 @@ def train_loop(solver: Solver, train_feed, test_feed, log=print) -> Dict[str, fl
         if (
             sp.snapshot
             and sp.snapshot_prefix
+            and multihost.is_primary()
             and (solver.iter % sp.snapshot == 0 or at_end)
         ):
             path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
@@ -193,21 +278,35 @@ def main(argv=None):
     ap.add_argument("--batch-size", type=int, default=0)
     ap.add_argument("--native-loader", action="store_true",
                     help="use the C++ prefetching data loader")
+    ap.add_argument("--parallel", choices=("none", "sync", "local"),
+                    default="none")
+    ap.add_argument("--tau", type=int, default=10,
+                    help="local-SGD sync period (the SparkNet τ knob)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
+    ap.add_argument("--weights", default=None, metavar="CAFFEMODEL",
+                    help="initialise weights from a .caffemodel (finetune)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
+    multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
     if args.restore:
         solver.restore(args.restore, train_feed)
-        print(f"Restoring previous solver status from {args.restore} "
-              f"(iter {solver.iter})")
-    print(
-        f"CifarApp: net={solver.net_param.name} params="
-        f"{W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
-    )
-    result = train_loop(solver, train_feed, test_feed)
+    if multihost.is_primary():
+        if args.restore:
+            print(f"Restoring previous solver status from {args.restore} "
+                  f"(iter {solver.iter})")
+        print(
+            f"CifarApp: net={solver.net_param.name} params="
+            f"{W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
+        )
+    from ..utils.profiling import trace
+
+    with trace(args.profile_dir):
+        result = train_loop(solver, train_feed, test_feed)
     return result
 
 

@@ -19,12 +19,14 @@ from typing import Dict, Iterator
 import jax.numpy as jnp
 import numpy as np
 
+import jax
+
 from ..data.imagenet import imagenet_dataset
 from ..data.preprocess import Transformer
 from ..nets import weights as W
 from ..proto import caffe_pb
 from ..solver.trainer import Solver, resolve_model_path
-from ..parallel import ParallelSolver, make_mesh
+from ..parallel import ParallelSolver, make_mesh, multihost
 from .cifar_app import _batch_size, _data_layer, train_loop
 
 ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "prototxt")
@@ -89,6 +91,22 @@ def build(args):
         synthetic_classes=classes,
     )
 
+    # multi-host: per-host data shards + local feed rows, global solver
+    # batch (see cifar_app.build)
+    nproc = jax.process_count()
+    feed_train_bs, feed_test_bs = train_bs, test_bs
+    if nproc > 1:
+        if args.parallel == "none":
+            raise ValueError("multi-host launch requires --parallel sync|local")
+        if train_bs % nproc or test_bs % nproc:
+            raise ValueError(
+                f"batch sizes ({train_bs}/{test_bs}) must divide across "
+                f"{nproc} processes"
+            )
+        train_ds = multihost.host_shard(train_ds)
+        test_ds = multihost.host_shard(test_ds)
+        feed_train_bs, feed_test_bs = train_bs // nproc, test_bs // nproc
+
     train_tf = Transformer.from_message(
         train_layer.transform_param if train_layer else None, train=True
     )
@@ -114,8 +132,10 @@ def build(args):
         solver = ParallelSolver(
             sp, shapes, mesh=make_mesh(), mode=args.parallel, tau=args.tau, **kw
         )
-    train_feed = make_feed(train_ds, train_tf, train_bs, seed=args.seed)
-    test_feed = make_feed(test_ds, test_tf, test_bs, seed=args.seed + 1)
+    if getattr(args, "weights", None):
+        solver.load_weights(args.weights)  # Caffe --weights finetuning
+    train_feed = make_feed(train_ds, train_tf, feed_train_bs, seed=args.seed)
+    test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     return solver, train_feed, test_feed
 
 
@@ -138,22 +158,32 @@ def parser() -> argparse.ArgumentParser:
                     help="bfloat16 compute (TPU-native matmul dtype)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
+    ap.add_argument("--weights", default=None, metavar="CAFFEMODEL",
+                    help="initialise weights from a .caffemodel (finetune)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
 
 def main(argv=None):
     args = parser().parse_args(argv)
+    multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
     if args.restore:
         solver.restore(args.restore, train_feed)
-        print(f"Restoring previous solver status from {args.restore} "
-              f"(iter {solver.iter})")
-    print(
-        f"ImageNetApp: net={solver.net_param.name} "
-        f"params={W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
-    )
-    return train_loop(solver, train_feed, test_feed)
+    if multihost.is_primary():
+        if args.restore:
+            print(f"Restoring previous solver status from {args.restore} "
+                  f"(iter {solver.iter})")
+        print(
+            f"ImageNetApp: net={solver.net_param.name} "
+            f"params={W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
+        )
+    from ..utils.profiling import trace
+
+    with trace(args.profile_dir):
+        return train_loop(solver, train_feed, test_feed)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,196 @@
+"""Caffe-native data sources: Data (LMDB/Datum), ImageData, HDF5Data.
+
+The reference's data layers read these exact on-disk formats through
+native Caffe (SURVEY.md §2 data loaders; mount empty, no file:line);
+here each becomes partition functions feeding
+:class:`~sparknet_tpu.data.rdd.ShardedDataset`, so the lineage /
+host-sharding semantics match the rest of the data plane.
+
+- ``Data``  — LMDB of serialized ``Datum`` (lmdb_io.py reader);
+  ``data_param { source, batch_size }``.
+- ``ImageData`` — ``source`` list file of ``<path> <label>`` lines
+  (PIL decode, optional new_height/new_width resize);
+  ``image_data_param { source, root_folder, new_height, new_width }``.
+- ``HDF5Data`` — ``source`` list file of .h5 paths, each with
+  ``data`` (N,C,H,W) + ``label`` datasets; ``hdf5_data_param``.
+
+All yield {"data": NHWC float32/uint8, "label": int32} like the rest
+of the loaders.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..proto import wire
+from .lmdb_io import LMDBReader
+from .rdd import ShardedDataset
+
+
+# ---------------------------------------------------------------------------
+# Datum (caffe.proto: channels=1 height=2 width=3 data=4 label=5
+#        float_data=6 encoded=7)
+# ---------------------------------------------------------------------------
+
+def decode_datum(buf: bytes) -> Tuple[np.ndarray, int]:
+    """Datum -> ((H, W, C) array, label). Pixel bytes are CHW order;
+    ``encoded`` datums hold a compressed image decoded via PIL."""
+    f = wire.decode(buf)
+    c = int(wire.first(f, 1, 0))
+    h = int(wire.first(f, 2, 0))
+    w = int(wire.first(f, 3, 0))
+    label = int(wire.first(f, 5, 0))
+    raw = wire.first(f, 4)
+    if wire.first(f, 7, 0) and raw is not None:  # encoded (JPEG/PNG)
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        # Caffe decodes encoded datums with OpenCV -> BGR; match it so
+        # binaryproto means and .caffemodel conv1 weights line up
+        return np.asarray(img, np.uint8)[:, :, ::-1], label
+    if raw is not None:
+        arr = np.frombuffer(raw, np.uint8).reshape(c, h, w)
+        return np.transpose(arr, (1, 2, 0)), label
+    data = wire.repeated_floats(f, 6)
+    arr = np.asarray(data, np.float32).reshape(c, h, w)
+    return np.transpose(arr, (1, 2, 0)), label
+
+
+def encode_datum(img: np.ndarray, label: int) -> bytes:
+    """(H, W, C) uint8/float -> Datum bytes (CHW, matching Caffe)."""
+    chw = np.transpose(np.asarray(img), (2, 0, 1))
+    c, h, w = chw.shape
+    out = (
+        wire.encode_varint_field(1, c)
+        + wire.encode_varint_field(2, h)
+        + wire.encode_varint_field(3, w)
+    )
+    if chw.dtype == np.uint8:
+        out += wire.encode_bytes_field(4, chw.tobytes())
+    else:
+        out += wire.encode_packed_floats(6, chw.reshape(-1))
+    return out + wire.encode_varint_field(5, int(label))
+
+
+# ---------------------------------------------------------------------------
+# Dataset constructors
+# ---------------------------------------------------------------------------
+
+def lmdb_dataset(source: str, num_partitions: int = 8) -> ShardedDataset:
+    reader = LMDBReader(source)
+    images: List[np.ndarray] = []
+    labels: List[int] = []
+    for _, val in reader.items():
+        img, label = decode_datum(val)
+        images.append(img)
+        labels.append(label)
+    return ShardedDataset.from_arrays(
+        {
+            "data": np.stack(images),
+            "label": np.asarray(labels, np.int32),
+        },
+        num_partitions,
+    )
+
+
+def image_data_dataset(
+    source: str,
+    root_folder: str = "",
+    new_height: int = 0,
+    new_width: int = 0,
+    files_per_part: int = 512,
+) -> ShardedDataset:
+    entries: List[Tuple[str, int]] = []
+    for line in open(source):
+        line = line.strip()
+        if not line:
+            continue
+        pth, _, lab = line.rpartition(" ")
+        entries.append((os.path.join(root_folder, pth), int(lab)))
+
+    def make(chunk):
+        def load() -> Dict[str, np.ndarray]:
+            from PIL import Image
+
+            imgs, labs = [], []
+            for pth, lab in chunk:
+                img = Image.open(pth).convert("RGB")
+                if new_height and new_width:
+                    img = img.resize((new_width, new_height), Image.BILINEAR)
+                imgs.append(np.asarray(img, np.uint8))
+                labs.append(lab)
+            return {
+                "data": np.stack(imgs),
+                "label": np.asarray(labs, np.int32),
+            }
+
+        return load
+
+    chunks = [
+        entries[i : i + files_per_part]
+        for i in range(0, len(entries), files_per_part)
+    ]
+    return ShardedDataset([make(c) for c in chunks])
+
+
+def hdf5_dataset(source: str) -> ShardedDataset:
+    """``source`` lists .h5 files (one per line), each with ``data``
+    (N,C,H,W) + ``label``; one partition per file, like Caffe cycles
+    files."""
+    files = [l.strip() for l in open(source) if l.strip()]
+
+    def make(path):
+        def load() -> Dict[str, np.ndarray]:
+            import h5py
+
+            with h5py.File(path, "r") as f:
+                data = np.asarray(f["data"])
+                label = np.asarray(f["label"]).reshape(-1).astype(np.int32)
+            if data.ndim == 4:  # NCHW -> NHWC
+                data = np.transpose(data, (0, 2, 3, 1))
+            return {"data": data.astype(np.float32), "label": label}
+
+        return load
+
+    return ShardedDataset([make(p) for p in files])
+
+
+def dataset_from_layer(layer, base_dir: str = ".") -> Optional[ShardedDataset]:
+    """Build the dataset a Caffe data layer describes, if its source
+    exists on disk; None otherwise (caller falls back)."""
+    if layer is None:
+        return None
+
+    def resolve(p):
+        for cand in (p, os.path.join(base_dir, p)):
+            if os.path.exists(cand):
+                return cand
+        return None
+
+    t = layer.type
+    if t == "Data":
+        p = layer.sub("data_param")
+        src = resolve(str(p.get("source"))) if p and p.get("source") else None
+        return lmdb_dataset(src) if src else None
+    if t == "ImageData":
+        p = layer.sub("image_data_param")
+        src = resolve(str(p.get("source"))) if p and p.get("source") else None
+        if not src:
+            return None
+        return image_data_dataset(
+            src,
+            root_folder=str(p.get("root_folder", "")),
+            new_height=int(p.get("new_height", 0)),
+            new_width=int(p.get("new_width", 0)),
+        )
+    if t == "HDF5Data":
+        p = layer.sub("hdf5_data_param")
+        src = resolve(str(p.get("source"))) if p and p.get("source") else None
+        return hdf5_dataset(src) if src else None
+    return None

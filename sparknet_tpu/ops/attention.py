@@ -108,85 +108,126 @@ def mha_reference_lse(q, k, v, **kw):
 
 # ---------------------------------------------------------------------------
 # Flash forward kernel
+#
+# K/V are STREAMED: the innermost grid dimension walks k-blocks, Pallas
+# block-fetches each (blk_k, d) tile from HBM, and the online-softmax
+# running state (acc, m, l) lives in VMEM scratch that persists across
+# those grid steps.  VMEM residency is O(blk_q*d + blk_k*d) regardless
+# of sequence length — S=32k runs in the same footprint as S=512.
 # ---------------------------------------------------------------------------
 
+
+def _dropout_keep(seed, rate, qi, kb, blk_q, blk_k):
+    """Deterministic per-(b,h,q-block,k-block) keep mask; forward and
+    both backward kernels regenerate the identical mask from the same
+    coordinates.  Mosaic seeds from at most two scalars, so the grid
+    coordinates fold into them: (seed ⊕ batch/head, q-block ⊕ k-block)."""
+    s1 = seed ^ (pl.program_id(0) * 65536 + pl.program_id(1))
+    s2 = qi * 65536 + kb
+    pltpu.prng_seed(s1, s2)
+    bits = pltpu.prng_random_bits((blk_q, blk_k))  # uint32
+    threshold = min(int(rate * 4294967296.0), 4294967295)
+    return bits >= jnp.uint32(threshold)
+
+
 def _fwd_kernel(
-    off_ref,  # SMEM (2,): [q_offset, kv_offset]
+    off_ref,  # SMEM (3,): [q_offset, kv_offset, dropout_seed]
     q_ref,    # (1, 1, blk_q, d)
-    k_ref,    # (1, 1, sk, d)
-    v_ref,    # (1, 1, sk, d)
-    m_ref,    # (1, blk_k or sk) int8 kv mask slice... (1, sk)
+    k_ref,    # (1, 1, blk_k, d)   — streamed over the last grid dim
+    v_ref,    # (1, 1, blk_k, d)
+    m_ref,    # (1, 8, blk_k) int8 kv mask block (sublane-broadcast: TPU
+              # requires >=8 sublanes per block)
     o_ref,    # (1, 1, blk_q, d)
-    lse_ref,  # (1, 1, blk_q)
+    lse_ref,  # (1, 1, blk_q, 128) f32, lane-replicated
+    acc_s,    # VMEM (blk_q, d) f32 — running numerator
+    m_s,      # VMEM (blk_q, 128) f32 — running max (lane-replicated)
+    l_s,      # VMEM (blk_q, 128) f32 — running denominator
     *,
     causal: bool,
     scale: float,
-    blk_k: int,
+    nkb: int,
+    dropout_rate: float,
 ):
     qi = pl.program_id(2)
-    blk_q = q_ref.shape[2]
-    d = q_ref.shape[3]
-    sk = k_ref.shape[2]
-    nkb = sk // blk_k
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
+    kb = pl.program_id(3)
+    blk_q, d = q_ref.shape[2], q_ref.shape[3]
+    blk_k = k_ref.shape[2]
     q_offset = off_ref[0]
     kv_offset = off_ref[1]
-    q_pos = (
-        jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-        + qi * blk_q
-        + q_offset
-    )
 
-    def body(kb, carry):
-        acc, m_i, l_i = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
+        k_blk = k_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (blk_q, blk_k)
-        kmask = m_ref[0, pl.ds(kb * blk_k, blk_k)]  # (blk_k,) int8
+        kmask = m_ref[0, 0]  # (blk_k,) int8
         s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
         if causal:
+            q_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+                + qi * blk_q + q_offset
+            )
             k_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-                + kb * blk_k
-                + kv_offset
+                + kb * blk_k + kv_offset
             )
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_i - m_new)
-        l_new = alpha * l_i + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        m_prev = m_s[:, 0:1]  # (blk_q, 1) — lanes hold identical values
+        l_prev = l_s[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        # l accumulates the UNdropped mass (softmax normalises before
+        # dropout); only the value accumulation sees the keep mask
+        l_s[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_s.shape
+        )
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(
+                off_ref[2], dropout_rate, qi, kb, blk_q, blk_k
+            )
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc, m_new, l_new
 
-    acc0 = jnp.zeros((blk_q, d), jnp.float32)
-    m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((blk_q,), jnp.float32)
     if causal:
-        # only blocks whose first key position can be <= the last query
-        # position participate; bound is traced (offsets are dynamic)
+        # blocks fully above the diagonal contribute nothing: skip the
+        # matmuls (state simply persists to the next grid step)
         last_q = qi * blk_q + blk_q - 1 + q_offset
-        nkb_eff = jnp.clip(
-            (last_q - kv_offset) // blk_k + 1, 0, nkb
-        )
+        first_k = kb * blk_k + kv_offset
+
+        @pl.when(first_k <= last_q)
+        def _():
+            compute()
     else:
-        nkb_eff = nkb
-    acc, m_i, l_i = jax.lax.fori_loop(0, nkb_eff, body, (acc0, m0, l0))
-    l_safe = jnp.maximum(l_i, 1e-30)
-    # a query row with no valid key (m_i never rose above NEG_INF)
-    # outputs zero, and its lse stays at NEG_INF so the backward
-    # kernels' masked-p guard zeroes its gradients too
-    dead = m_i <= NEG_INF * 0.5
-    o_ref[0, 0] = jnp.where(
-        dead[:, None], 0.0, acc / l_safe[:, None]
-    ).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.where(dead, NEG_INF, m_i + jnp.log(l_safe))
+        compute()
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        m_i = m_s[:, 0:1]
+        l_i = l_s[:, 0:1]
+        l_safe = jnp.maximum(l_i, 1e-30)
+        # a query row with no valid key (m never rose above NEG_INF)
+        # outputs zero, and its lse stays at NEG_INF so the backward
+        # kernels' masked-p guard zeroes its gradients too
+        dead = m_i <= NEG_INF * 0.5
+        o_ref[0, 0] = jnp.where(
+            dead, 0.0, acc_s[...] / l_safe
+        ).astype(o_ref.dtype)
+        lse = jnp.where(dead, NEG_INF, m_i + jnp.log(l_safe))  # (blk_q, 1)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 # ---------------------------------------------------------------------------
@@ -195,32 +236,39 @@ def _fwd_kernel(
 
 def _bwd_dq_kernel(
     off_ref, q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
-    dq_ref, *, causal: bool, scale: float, blk_k: int
+    dq_ref, dq_s, *, causal: bool, scale: float, nkb: int,
+    dropout_rate: float,
 ):
+    """Grid (b, h, nq, nk): K/V stream over the last dim, dq accumulates
+    in VMEM scratch and is written once on the final k step."""
     qi = pl.program_id(2)
+    kb = pl.program_id(3)
     blk_q, d = q_ref.shape[2], q_ref.shape[3]
-    sk = k_ref.shape[2]
-    nkb = sk // blk_k
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    blk_k = k_ref.shape[2]
     q_offset, kv_offset = off_ref[0], off_ref[1]
-    q_pos = (
-        jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-        + qi * blk_q + q_offset
-    )
 
-    def body(kb, dq):
-        k_blk = k_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]    # (blk_q, 1), lane-replicated
+        delta = delta_ref[0, 0, :, 0:1]
+        k_blk = k_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        kmask = m_ref[0, pl.ds(kb * blk_k, blk_k)]
+        kmask = m_ref[0, 0]
         s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
         if causal:
+            q_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+                + qi * blk_q + q_offset
+            )
             k_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
                 + kb * blk_k + kv_offset
@@ -228,51 +276,63 @@ def _bwd_dq_kernel(
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         # masked logits must yield p=0 even when lse is itself NEG_INF
         # (fully-padded row): exp(NEG_INF - NEG_INF) would be 1
-        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse[:, None]))
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(
+                off_ref[2], dropout_rate, qi, kb, blk_q, blk_k
+            )
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta)
+        dq_s[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
     if causal:
         last_q = qi * blk_q + blk_q - 1 + q_offset
-        nkb_eff = jnp.clip((last_q - kv_offset) // blk_k + 1, 0, nkb)
+        first_k = kb * blk_k + kv_offset
+
+        @pl.when(first_k <= last_q)
+        def _():
+            compute()
     else:
-        nkb_eff = nkb
-    dq = jax.lax.fori_loop(
-        0, nkb_eff, body, jnp.zeros((blk_q, d), jnp.float32)
-    )
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+        compute()
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        dq_ref[0, 0] = (dq_s[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     off_ref, q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, *, causal: bool, scale: float, blk_q: int
+    dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float, nqb: int,
+    dropout_rate: float,
 ):
+    """Grid (b, h, nk, nq): Q/dO/lse/delta stream over the last dim,
+    dk/dv accumulate in VMEM scratch, written once on the final q step."""
     ki = pl.program_id(2)
+    qb = pl.program_id(3)
     blk_k, d = k_ref.shape[2], k_ref.shape[3]
-    sq = q_ref.shape[2]
-    nqb = sq // blk_q
-    k_blk = k_ref[0, 0].astype(jnp.float32)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
-    kmask = m_ref[0, pl.ds(ki * blk_k, blk_k)]
+    blk_q = q_ref.shape[2]
     q_offset, kv_offset = off_ref[0], off_ref[1]
-    k_pos = (
-        jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-        + ki * blk_k + kv_offset
-    )
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, 0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
-        delta = delta_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
+    @pl.when(qb == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    def compute():
+        k_blk = k_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        kmask = m_ref[0, 0]
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]    # (blk_q, 1), lane-replicated
+        delta = delta_ref[0, 0, :, 0:1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -283,150 +343,245 @@ def _bwd_dkv_kernel(
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
                 + qb * blk_q + q_offset
             )
+            k_pos = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+                + ki * blk_k + kv_offset
+            )
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         # same masked-p guard as _bwd_dq_kernel (fully-padded rows)
         p = jnp.where(
-            s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse[:, None])
+            s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse)
         )  # (blk_q, blk_k)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+        if dropout_rate > 0.0:
+            # mask coordinates are (q-block, k-block) — matches fwd/dq
+            keep = _dropout_keep(
+                off_ref[2], dropout_rate, qb, ki, blk_q, blk_k
+            )
+            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        else:
+            p_drop = p
+        dv_s[...] += jax.lax.dot_general(
+            p_drop, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(
+        if dropout_rate > 0.0:
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta)
+        dk_s[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
     if causal:
-        # first q block that can see this k block
-        first_q = jnp.clip(
-            (ki * blk_k + kv_offset - q_offset) // blk_q, 0, nqb
-        )
+        # q blocks fully before the diagonal can't see this k block
+        last_q = qb * blk_q + blk_q - 1 + q_offset
+        first_k = ki * blk_k + kv_offset
+
+        @pl.when(first_k <= last_q)
+        def _():
+            compute()
     else:
-        first_q = 0
-    dk, dv = jax.lax.fori_loop(
-        first_q, nqb, body,
-        (jnp.zeros((blk_k, d), jnp.float32), jnp.zeros((blk_k, d), jnp.float32)),
-    )
-    # q entered the loop pre-scaled, so ds^T @ q already carries `scale`
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        compute()
+
+    @pl.when(qb == nqb - 1)
+    def _finalize():
+        # q entered the matmuls pre-scaled, so ds^T @ q carries `scale`
+        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
 # pallas_call wrappers + custom VJP
 # ---------------------------------------------------------------------------
 
-def _specs(b, h, sq, sk, d, blk_q):
-    """Common in_specs for (offsets, q, k, v, mask)."""
+_SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
+
+
+def _params(interpret):
+    if interpret:
+        return {"interpret": True}
+    return {
+        "interpret": False,
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=_SEMANTICS
+        ),
+    }
+
+
+def _qk_specs(blk_q, blk_k, d):
+    """in_specs for (offsets, q, k, v, mask) on a (b, h, nq, nk) grid:
+    q indexed by the q-block dim, k/v/mask streamed over the k-block dim.
+    The kv mask arrives sublane-broadcast as (b, 8, sk)."""
     return [
-        pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets (2,)
-        pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
-        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-        pl.BlockSpec((1, sk), lambda b_, h_, i: (b_, 0)),
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets (3,)
+        pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        pl.BlockSpec((1, 8, blk_k), lambda b_, h_, i, j: (b_, 0, j)),
     ]
 
 
-def _flash_fwd(q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret):
+def _flash_fwd(
+    q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
+    dropout_rate,
+):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    grid = (b, h, sq // blk_q)
+    nkb = sk // blk_k
+    grid = (b, h, sq // blk_q, nkb)
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, blk_k=blk_k
+        _fwd_kernel, causal=causal, scale=scale, nkb=nkb,
+        dropout_rate=dropout_rate,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=_specs(b, h, sq, sk, d, blk_q),
+        in_specs=_qk_specs(blk_q, blk_k, d),
         out_specs=[
-            pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, blk_q), lambda b_, h_, i: (b_, h_, i)),
+            pl.BlockSpec(
+                (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, i, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            # lane-replicated: TPU blocks need a 128-lane trailing dim
+            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
         ],
-        interpret=interpret,
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, d), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+        ],
+        **_params(interpret),
     )(offsets, q, k, v, kv_mask)
     return out, lse
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret):
+def _flash(
+    q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
+    dropout_rate,
+):
     out, _ = _flash_fwd(
-        q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret
+        q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
+        dropout_rate,
     )
     return out
 
 
-def _flash_vjp_fwd(q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret):
+def _flash_vjp_fwd(
+    q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
+    dropout_rate,
+):
     out, lse = _flash_fwd(
-        q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret
+        q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
+        dropout_rate,
     )
-    return out, (q, k, v, kv_mask, offsets, out, lse)
+    # residual keeps one lane of the lane-replicated lse — 1/128th the
+    # HBM; the backward broadcasts it back transiently (like delta)
+    return out, (q, k, v, kv_mask, offsets, out, lse[..., 0])
 
 
-def _flash_vjp_bwd(causal, scale, blk_q, blk_k, interpret, res, do):
+def _flash_vjp_bwd(
+    causal, scale, blk_q, blk_k, interpret, dropout_rate, res, do
+):
     q, k, v, kv_mask, offsets, out, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (b, h, sq)
+    nqb, nkb = sq // blk_q, sk // blk_k
+    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, 128))
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        ),
+        (b, h, sq, 128),
+    )  # lane-replicated, same layout as lse
 
-    bwd_in_specs = _specs(b, h, sq, sk, d, blk_q) + [
-        pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)),  # do
-        pl.BlockSpec((1, 1, blk_q), lambda b_, h_, i: (b_, h_, i)),  # lse
-        pl.BlockSpec((1, 1, blk_q), lambda b_, h_, i: (b_, h_, i)),  # delta
+    # dq: grid (b, h, nq, nk) — K/V streamed, dq carried in scratch
+    dq_specs = _qk_specs(blk_q, blk_k, d) + [
+        pl.BlockSpec(
+            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+        ),  # do
+        pl.BlockSpec(
+            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, i, 0)
+        ),  # lse
+        pl.BlockSpec(
+            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, i, 0)
+        ),  # delta
     ]
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, causal=causal, scale=scale, blk_k=blk_k
+            _bwd_dq_kernel, causal=causal, scale=scale, nkb=nkb,
+            dropout_rate=dropout_rate,
         ),
-        grid=(b, h, sq // blk_q),
-        in_specs=bwd_in_specs,
+        grid=(b, h, nqb, nkb),
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec(
-            (1, 1, blk_q, d), lambda b_, h_, i: (b_, h_, i, 0)
+            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        **_params(interpret),
     )(offsets, q, k, v, kv_mask, do, lse, delta)
 
-    # dkv: grid over k blocks; q/do/lse/delta full rows resident
+    # dkv: grid (b, h, nk, nq) — q/do/lse/delta streamed over q blocks,
+    # dk/dv carried in scratch
     dkv_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),  # q
-        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),  # k
-        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),  # v
-        pl.BlockSpec((1, sk), lambda b_, h_, i: (b_, 0)),  # mask
-        pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),  # do
-        pl.BlockSpec((1, 1, sq), lambda b_, h_, i: (b_, h_, 0)),  # lse
-        pl.BlockSpec((1, 1, sq), lambda b_, h_, i: (b_, h_, 0)),  # delta
+        pl.BlockSpec(
+            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, j, 0)
+        ),  # q
+        pl.BlockSpec(
+            (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+        ),  # k
+        pl.BlockSpec(
+            (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+        ),  # v
+        pl.BlockSpec((1, 8, blk_k), lambda b_, h_, i, j: (b_, 0, i)),  # mask
+        pl.BlockSpec(
+            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, j, 0)
+        ),  # do
+        pl.BlockSpec(
+            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, j, 0)
+        ),  # lse
+        pl.BlockSpec(
+            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, j, 0)
+        ),  # delta
     ]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, causal=causal, scale=scale, blk_q=blk_q
+            _bwd_dkv_kernel, causal=causal, scale=scale, nqb=nqb,
+            dropout_rate=dropout_rate,
         ),
-        grid=(b, h, sk // blk_k),
+        grid=(b, h, nkb, nqb),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec(
+                (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        interpret=interpret,
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        **_params(interpret),
     )(offsets, q, k, v, kv_mask, do, lse, delta)
     return dq, dk, dv, None, None
 
@@ -444,6 +599,8 @@ def flash_attention(
     scale: Optional[float] = None,
     q_offset=0,
     kv_offset=0,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
@@ -452,21 +609,46 @@ def flash_attention(
     largest divisor of the sequence length (gcd with the requested
     block), so any length works — 128-multiples get full-size MXU
     blocks; prefer those. Offsets may be traced scalars — ring
-    attention passes per-step shard offsets."""
+    attention passes per-step shard offsets.
+
+    Attention-probability dropout runs inside the kernels via the TPU
+    PRNG, seeded per (batch, head, q-block, k-block) so forward and both
+    backward passes regenerate identical keep masks."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = math.gcd(sq, block_q)
     block_k = math.gcd(sk, block_k)
+    # Mosaic block legality: the q block must be a sublane multiple (or
+    # the whole axis), the k block a lane multiple (or the whole axis) —
+    # odd lengths fall back to full-axis blocks.
+    if block_q % 8:
+        block_q = sq
+    if block_k % 128:
+        block_k = sk
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if kv_mask is None:
         kv_mask = jnp.ones((b, sk), jnp.int8)
     else:
         kv_mask = kv_mask.astype(jnp.int8)
+    # sublane-broadcast for the (1, 8, blk_k) mask block spec
+    kv_mask = jnp.broadcast_to(kv_mask[:, None, :], (b, 8, sk))
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        seed = jax.lax.bitcast_convert_type(
+            jnp.asarray(dropout_rng).reshape(-1)[-1], jnp.int32
+        )
+    else:
+        dropout_rate = 0.0
+        seed = jnp.asarray(0, jnp.int32)
     offsets = jnp.stack(
-        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(kv_offset, jnp.int32)]
+        [
+            jnp.asarray(q_offset, jnp.int32),
+            jnp.asarray(kv_offset, jnp.int32),
+            seed,
+        ]
     )
     return _flash(
-        q, k, v, kv_mask, offsets, causal, scale, block_q, block_k, interpret
+        q, k, v, kv_mask, offsets, causal, scale, block_q, block_k,
+        interpret, float(dropout_rate),
     )
 
 
@@ -478,23 +660,31 @@ def attention(
     """Dispatch: Pallas flash on TPU, reference elsewhere.
 
     ``force`` = "flash" | "reference" overrides (tests, benchmarks).
-    Attention-probability dropout is only implemented in the reference
-    path; an active dropout (rate > 0 with an rng) routes there even on
-    TPU rather than silently skipping it.
+    Attention-probability dropout exists on both paths; the flash
+    kernels implement it via the in-kernel TPU PRNG.  Because the TPU
+    PRNG lowering has no CPU/interpret fallback and is young on this
+    toolchain, *auto* dispatch keeps active dropout on the reference
+    path unless ``SPARKNET_FLASH_DROPOUT=1`` (or ``force="flash"``)
+    opts in — an explicit, documented policy rather than a silent skip.
     """
+    import os
+
     dropping = dropout_rate > 0.0 and dropout_rng is not None
-    use_flash = (
-        force == "flash"
-        or (force is None and jax.default_backend() == "tpu" and pltpu is not None)
-    ) and not dropping
+    flash_dropout_ok = bool(int(os.environ.get("SPARKNET_FLASH_DROPOUT", "0")))
+    use_flash = force == "flash" or (
+        force is None
+        and jax.default_backend() == "tpu"
+        and pltpu is not None
+        and (not dropping or flash_dropout_ok)
+    )
     if use_flash:
         return flash_attention(
             q, k, v, causal=causal, kv_mask=kv_mask, scale=scale,
-            q_offset=q_offset, kv_offset=kv_offset, **flash_kw
+            q_offset=q_offset, kv_offset=kv_offset,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng, **flash_kw
         )
     return mha_reference(
         q, k, v, causal=causal, kv_mask=kv_mask, scale=scale,
         q_offset=q_offset, kv_offset=kv_offset,
-        dropout_rate=dropout_rate if dropping else 0.0,
-        dropout_rng=dropout_rng,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng,
     )

@@ -21,8 +21,10 @@ from .local_sgd import (
     stack_round_batches,
 )
 from .trainer import ParallelSolver
+from . import multihost
 
 __all__ = [
+    "multihost",
     "DP_AXIS",
     "PP_AXIS",
     "SP_AXIS",

@@ -1,10 +1,23 @@
 """Mixture-of-Experts FFN with expert parallelism over an "ep" axis.
 
 No reference counterpart (SURVEY.md §2: data parallelism only; EP is a
-task-spec obligation). Switch-Transformer-style top-1 routing with a
-fixed per-expert capacity, expressed as dense one-hot dispatch/combine
-einsums — static shapes, MXU-friendly, no sorting/segment ops that
-would defeat XLA on TPU.
+task-spec obligation). Switch/GShard-style routing with a fixed
+per-expert capacity:
+
+- ``top_k=1`` — Switch semantics: gate is the chosen expert's raw
+  router probability.
+- ``top_k>=2`` — GShard semantics: gates renormalised over the chosen
+  experts; first choices win capacity over second choices.
+- ``z_loss_weight`` — router z-loss (mean logsumexp² of the router
+  logits) folded into the aux scalar, stabilising router magnitudes.
+
+Two dispatch implementations, numerically identical:
+
+- ``dispatch="dense"`` — one-hot dispatch/combine einsums, O(T·E·C)
+  memory. Static shapes, MXU-friendly; best at small T·E.
+- ``dispatch="sort"`` — argsort tokens by expert, position-in-expert
+  via searchsorted, scatter/gather into the (E, C, h) buffer. O(T·h)
+  memory; the only viable layout at realistic T and E.
 
 Under ``shard_map`` over ``ep``, the expert weight stacks shard on
 their leading (expert) axis and tokens travel to their expert's owner
@@ -20,7 +33,7 @@ semantics.
 from __future__ import annotations
 
 import math
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,21 +72,99 @@ def moe_pspecs(ep_axis: str = "ep"):
     }
 
 
+def _route(logits: jax.Array, top_k: int) -> Tuple[jax.Array, jax.Array]:
+    """(gates, experts), both (T, K).  Switch gate for k=1, GShard
+    renormalised gates for k>=2."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_probs, top_idx = lax.top_k(probs, top_k)
+    if top_k == 1:
+        gates = top_probs
+    else:
+        gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+    return gates, top_idx
+
+
+def _dense_dispatch(xt, expert_s, gate_s, e_total, cap, top_k):
+    """One-hot (S, E, C) dispatch/combine tensors; S = T*top_k slots in
+    choice-major order (all first choices before all second choices, so
+    first choices win capacity)."""
+    t = xt.shape[0]
+    onehot = jax.nn.one_hot(expert_s, e_total, dtype=jnp.float32)  # (S, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    pos_slot = jnp.sum(pos * onehot, axis=-1)  # (S,)
+    keep = (pos_slot < cap) & (pos_slot >= 0)
+    pos_oh = jax.nn.one_hot(
+        jnp.where(keep, pos_slot, cap).astype(jnp.int32), cap,
+        dtype=jnp.float32,
+    )  # (S, C); dropped slots land outside the one-hot range -> zeros
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]  # (S, E, C)
+    combine = dispatch * gate_s[:, None, None]
+    xs = jnp.tile(xt, (top_k, 1))  # slot s holds token s % T
+    expert_in = jnp.einsum(
+        "sec,sh->ech", dispatch, xs.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # (E, C, h)
+
+    def combine_fn(y):  # y: (E, C, h) -> (T, h)
+        out_slots = jnp.einsum(
+            "sec,ech->sh", combine, y.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.sum(out_slots.reshape(top_k, t, -1), axis=0)
+
+    return expert_in, combine_fn
+
+
+def _sort_dispatch(xt, expert_s, gate_s, e_total, cap, top_k):
+    """Sort-based dispatch: O(S log S) routing + O(E*C*h) buffer instead
+    of the dense O(S*E*C) tensors.  Same slot priority as the dense
+    path (stable sort over choice-major slots)."""
+    t, h = xt.shape
+    s = t * top_k
+    order = jnp.argsort(expert_s, stable=True)  # (S,) slot ids by expert
+    sorted_e = expert_s[order]
+    starts = jnp.searchsorted(sorted_e, jnp.arange(e_total), side="left")
+    pos_sorted = jnp.arange(s) - starts[sorted_e]  # position within expert
+    keep = pos_sorted < cap
+    dest = jnp.where(keep, sorted_e * cap + pos_sorted, e_total * cap)
+    tok_sorted = order % t  # slot -> owning token (choice-major layout)
+    buf = jnp.zeros((e_total * cap + 1, h), jnp.float32)
+    buf = buf.at[dest].add(xt[tok_sorted].astype(jnp.float32))
+    expert_in = buf[:-1].reshape(e_total, cap, h)
+
+    def combine_fn(y):  # y: (E, C, h) -> (T, h)
+        y_flat = jnp.concatenate(
+            [y.reshape(e_total * cap, h), jnp.zeros((1, h), y.dtype)]
+        )
+        out_slots = y_flat[dest].astype(jnp.float32) * gate_s[order][:, None]
+        return (
+            jnp.zeros((t, h), jnp.float32).at[tok_sorted].add(out_slots)
+        )
+
+    return expert_in, combine_fn
+
+
 def moe_ffn(
     x: jax.Array,
     params: Dict[str, jax.Array],
     *,
     ep_axis: Optional[str] = None,
     capacity_factor: float = 1.25,
+    top_k: int = 1,
+    z_loss_weight: float = 0.0,
+    dispatch: str = "dense",
     compute_dtype=jnp.float32,
 ):
-    """Top-1 MoE FFN. x: (..., T, h) flattened to tokens internally.
+    """MoE FFN. x: (..., T, h) flattened to tokens internally.
 
     Returns (out, aux) where ``out`` has x's shape (zero rows for
     capacity-dropped tokens — add the residual outside) and ``aux`` is
-    the Switch load-balancing loss (scalar; add to the training loss
-    with a small coefficient, e.g. 0.01).
+    the Switch load-balancing loss plus ``z_loss_weight`` times the
+    router z-loss (scalar; add to the training loss with a small
+    coefficient, e.g. 0.01).
     """
+    if dispatch not in ("dense", "sort"):
+        raise ValueError(f"dispatch {dispatch!r} (want 'dense' or 'sort')")
     orig_shape = x.shape
     h = orig_shape[-1]
     xt = x.reshape(-1, h)  # (T, h)
@@ -82,40 +173,39 @@ def moe_ffn(
     nep = lax.psum(1, ep_axis) if ep_axis is not None else 1
     if e_total % nep:
         raise ValueError(f"experts ({e_total}) not divisible by ep ({nep})")
+    if top_k > e_total:
+        raise ValueError(f"top_k ({top_k}) > experts ({e_total})")
 
     logits = jnp.dot(
         xt.astype(jnp.float32), params["router_w"],
         preferred_element_type=jnp.float32,
     )  # (T, E)
+    gates, top_idx = _route(logits, top_k)
+    # choice-major slots: all first choices, then all second choices
+    expert_s = top_idx.T.reshape(-1)  # (S,)
+    gate_s = gates.T.reshape(-1)
+
+    cap = max(1, int(math.ceil(t * top_k / e_total * capacity_factor)))
+    dispatch_fn = _dense_dispatch if dispatch == "dense" else _sort_dispatch
+    expert_in, combine_fn = dispatch_fn(
+        xt, expert_s, gate_s, e_total, cap, top_k
+    )
+
+    # Switch aux loss over first-choice assignment:
+    # E * sum_e (fraction tokens to e) * (mean prob e)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-
-    cap = max(1, int(math.ceil(t / e_total * capacity_factor)))
-    onehot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)  # (T, E)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (T, E), -1 elsewhere
-    pos_tok = jnp.sum(pos * onehot, axis=-1)  # (T,)
-    keep = (pos_tok < cap) & (pos_tok >= 0)
-    # dispatch tensor (T, E, C)
-    pos_oh = jax.nn.one_hot(
-        jnp.where(keep, pos_tok, cap).astype(jnp.int32), cap, dtype=jnp.float32
-    )  # (T, C); overflow rows land outside the one-hot range -> zeros
-    dispatch = onehot[:, :, None] * pos_oh[:, None, :]  # (T, E, C)
-    combine = dispatch * gate[:, None, None]
-
-    # Switch aux loss: E * sum_e (fraction tokens to e) * (mean prob e)
-    frac = jnp.mean(onehot, axis=0)
+    frac = jnp.mean(
+        jax.nn.one_hot(top_idx[:, 0], e_total, dtype=jnp.float32), axis=0
+    )
     mean_prob = jnp.mean(probs, axis=0)
+    z = jax.scipy.special.logsumexp(logits, axis=-1)
+    z_loss = jnp.mean(jnp.square(z))
     if ep_axis is not None:
         frac = lax.pmean(frac, ep_axis)
         mean_prob = lax.pmean(mean_prob, ep_axis)
-    aux = e_total * jnp.sum(frac * mean_prob)
+        z_loss = lax.pmean(z_loss, ep_axis)
+    aux = e_total * jnp.sum(frac * mean_prob) + z_loss_weight * z_loss
 
-    expert_in = jnp.einsum(
-        "tec,th->ech", dispatch, xt.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )  # (E, C, h)
     if ep_axis is not None:
         # route token groups to the experts' owners: (E, C, h) ->
         # (E/n, n*C, h); the local expert dim now matches w_in's shard
@@ -143,8 +233,5 @@ def moe_ffn(
         y = lax.all_to_all(
             y, ep_axis, split_axis=1, concat_axis=0, tiled=True
         )  # back to (E, C, h) token-owner layout
-    out = jnp.einsum(
-        "tec,ech->th", combine, y.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+    out = combine_fn(y)
     return out.reshape(orig_shape).astype(x.dtype), aux

@@ -1,0 +1,92 @@
+"""Multi-host bring-up: the framework's deployment layer.
+
+The reference deploys as a Spark application: a driver JVM schedules
+executor JVMs across an EC2/Mesos cluster, each owning one GPU, with
+Spark's TCP fabric carrying weights (SURVEY.md §1 "Deployment", §2
+"EC2/cluster scripts"; mount empty, no file:line).  The TPU-native
+equivalent is JAX's multi-controller model: one identical Python
+process per host, ``jax.distributed.initialize`` wiring them into a
+single global device mesh, and ICI/DCN carrying the collectives that
+replace Spark's shuffle.  There is no driver — every process runs the
+same SPMD program; process 0 merely owns logging and snapshots.
+
+Launch (one command per host, see docs/MULTIHOST.md):
+
+    SPARKNET_COORDINATOR=host0:1234 SPARKNET_NUM_PROCESSES=4 \\
+    SPARKNET_PROCESS_ID=<i> python -m sparknet_tpu.apps.imagenet_app \\
+        --multihost ...
+
+Data: each host feeds only its shard (``host_shard``), and
+``jax.make_array_from_process_local_data`` assembles the host-local
+rows into one globally-sharded batch — the same global-batch semantics
+as the reference's RDD partitioning, minus the driver round-trip.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host cluster; returns True if distributed mode is
+    active.  Arguments fall back to ``SPARKNET_COORDINATOR`` /
+    ``SPARKNET_NUM_PROCESSES`` / ``SPARKNET_PROCESS_ID`` env vars (and
+    then to JAX's own cluster auto-detection).  A single-process launch
+    (no coordinator configured) is a no-op."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "SPARKNET_COORDINATOR"
+    )
+    if num_processes is None and "SPARKNET_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["SPARKNET_NUM_PROCESSES"])
+    if process_id is None and "SPARKNET_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["SPARKNET_PROCESS_ID"])
+    if coordinator_address is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """Process 0 owns logging and snapshot writes (the reference's
+    driver-side responsibilities)."""
+    return jax.process_index() == 0
+
+
+def host_shard(ds):
+    """This host's partitions of a ShardedDataset (deterministic
+    ``i % num_hosts`` assignment — rdd.py's sharding arithmetic)."""
+    if jax.process_count() == 1:
+        return ds
+    return ds.shard(jax.process_index(), jax.process_count())
+
+
+def put_global(batch: Any, sharding: jax.sharding.NamedSharding) -> Any:
+    """Assemble per-host local rows into one globally-sharded array
+    pytree.  Each process passes its own rows; process order defines
+    global order along the sharded axis."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        batch,
+    )

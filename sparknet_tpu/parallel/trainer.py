@@ -30,7 +30,8 @@ from .local_sgd import (
     round_batch_sharding,
     stack_round_batches,
 )
-from .mesh import DP_AXIS, make_mesh, replicate
+from .mesh import DP_AXIS, batch_sharding, make_mesh, replicate
+from . import multihost
 
 
 class ParallelSolver(Solver):
@@ -61,6 +62,16 @@ class ParallelSolver(Solver):
                     )
         self.params = replicate(self.params, self.mesh)
         self.state = replicate(self.state, self.mesh)
+        # multi-host: each process feeds its local rows; _put_batch
+        # assembles them into globally-sharded arrays
+        self._multihost = jax.process_count() > 1
+        self._eval_sharding = batch_sharding(self.mesh, dp_axis)
+        if solver.iter_size > 1:
+            self._train_sharding = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(None, dp_axis)
+            )
+        else:
+            self._train_sharding = self._eval_sharding
         if mode == "sync":
             self.opt_state = replicate(self.opt_state, self.mesh)
             self._train_step = make_dp_train_step(
@@ -88,6 +99,15 @@ class ParallelSolver(Solver):
             raise ValueError(f"mode {mode!r} (want 'sync' or 'local')")
 
     # ------------------------------------------------------------------
+    def _put_batch(self, batch, train: bool = True):
+        """sync mode: jit's in_shardings place single-host batches; with
+        multiple processes each host contributes only its local rows, so
+        the global array must be assembled explicitly."""
+        if not self._multihost:
+            return batch
+        sharding = self._train_sharding if train else self._eval_sharding
+        return multihost.put_global(batch, sharding)
+
     def _place_restored(self, params, state, opt_state):
         params = replicate(params, self.mesh)
         state = replicate(state, self.mesh)
@@ -128,7 +148,10 @@ class ParallelSolver(Solver):
             stacked = stack_round_batches(
                 [self._next_iteration_batch(batches) for _ in range(tau)]
             )
-            stacked = jax.device_put(stacked, self._batch_sharding)
+            if self._multihost:
+                stacked = multihost.put_global(stacked, self._batch_sharding)
+            else:
+                stacked = jax.device_put(stacked, self._batch_sharding)
             self.rng, step_rng = jax.random.split(self.rng)
             prev = self.iter
             self.params, self.state, self.opt_state, metrics = self._round_fn(tau)(

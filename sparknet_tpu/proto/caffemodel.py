@@ -1,0 +1,240 @@
+"""``.caffemodel`` / ``.binaryproto`` weight interchange.
+
+The reference snapshots and ships weights as binary ``NetParameter``
+protos — the Caffe zoo's published ``bvlc_alexnet.caffemodel`` etc.
+(SURVEY.md §2 prototxt model zoo; mount empty, no file:line).  This
+module reads and writes that format against the framework's
+WeightCollection, handling the layout transposition:
+
+- Convolution: Caffe OIHW  ->  ours HWIO (``nets/layers.py:13``)
+- InnerProduct: Caffe (out, in) with in flattened CHW  ->  ours
+  (in, out) with in flattened HWC; the row permutation is derived from
+  the net's blob shapes, so flatten bit-compat holds end-to-end.
+- BatchNorm: Caffe's unnormalized sum blobs (+ scale factor) ->
+  normalized running mean/var in the state pytree.
+
+Field numbers follow caffe.proto (BVLC master): NetParameter.name=1,
+.layer=100 (V2), .layers=2 (V1); LayerParameter.name=1/.type=2/
+.blobs=7; V1LayerParameter.name=4/.blobs=6; BlobProto.shape=7/.data=5/
+.num..width=1..4; BlobShape.dim=1.  Verified against google.protobuf
+dynamic messages in tests/test_caffemodel.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from . import wire
+
+WeightBlobs = Dict[str, List[np.ndarray]]  # layer name -> caffe-layout blobs
+
+
+# ---------------------------------------------------------------------------
+# Reading
+# ---------------------------------------------------------------------------
+
+def read_blob(buf: bytes) -> np.ndarray:
+    """BlobProto -> array in Caffe's native dim order."""
+    f = wire.decode(buf)
+    data = wire.repeated_floats(f, 5)
+    if not data and 8 in f:  # double_data
+        import struct
+
+        out: List[float] = []
+        for raw in f[8]:
+            if isinstance(raw, bytes):
+                out.extend(struct.unpack(f"<{len(raw) // 8}d", raw))
+            else:
+                out.append(struct.unpack("<d", struct.pack("<Q", raw))[0])
+        data = out
+    if 7 in f:  # BlobShape
+        shape = wire.repeated_ints(wire.decode(f[7][-1]), 1)
+    else:  # legacy num/channels/height/width
+        shape = [int(wire.first(f, i, 1)) for i in (1, 2, 3, 4)]
+        while len(shape) > 1 and shape[0] == 1:
+            shape = shape[1:]
+    arr = np.asarray(data, np.float32)
+    return arr.reshape(shape) if shape else arr
+
+
+def load_caffemodel(path_or_bytes) -> Tuple[str, WeightBlobs]:
+    """Parse a binary NetParameter -> (net name, layer blobs)."""
+    buf = (
+        path_or_bytes
+        if isinstance(path_or_bytes, (bytes, bytearray))
+        else open(path_or_bytes, "rb").read()
+    )
+    f = wire.decode(bytes(buf))
+    name = wire.first(f, 1, b"").decode()
+    blobs: WeightBlobs = {}
+    for raw in f.get(100, []):  # LayerParameter (V2)
+        lf = wire.decode(raw)
+        lname = wire.first(lf, 1, b"").decode()
+        lb = [read_blob(b) for b in lf.get(7, [])]
+        if lb:
+            blobs[lname] = lb
+    for raw in f.get(2, []):  # V1LayerParameter
+        lf = wire.decode(raw)
+        lname = wire.first(lf, 4, b"").decode()
+        lb = [read_blob(b) for b in lf.get(6, [])]
+        if lb:
+            blobs.setdefault(lname, lb)
+    return name, blobs
+
+
+def load_binaryproto_mean(path_or_bytes) -> np.ndarray:
+    """``mean_file`` BlobProto -> (H, W, C) float32 NHWC mean image."""
+    buf = (
+        path_or_bytes
+        if isinstance(path_or_bytes, (bytes, bytearray))
+        else open(path_or_bytes, "rb").read()
+    )
+    arr = read_blob(bytes(buf))
+    if arr.ndim == 3:  # (C, H, W) -> (H, W, C)
+        return np.transpose(arr, (1, 2, 0))
+    if arr.ndim == 4:  # (1, C, H, W)
+        return np.transpose(arr[0], (1, 2, 0))
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Layout conversion against a compiled net
+# ---------------------------------------------------------------------------
+
+def _ip_rows_chw_to_hwc(w: np.ndarray, bottom_shape) -> np.ndarray:
+    """(out, in) rows flattened CHW -> flattened HWC, when the bottom
+    blob is 4D; identity otherwise."""
+    if len(bottom_shape) != 4:
+        return w
+    _, h, wd, c = bottom_shape
+    if w.shape[1] != c * h * wd:
+        raise ValueError(
+            f"IP weight in-dim {w.shape[1]} != bottom {c}*{h}*{wd}"
+        )
+    return (
+        w.reshape(w.shape[0], c, h, wd).transpose(0, 2, 3, 1)
+        .reshape(w.shape[0], h * wd * c)
+    )
+
+
+def import_caffemodel(path_or_bytes, net) -> Tuple[Dict, Dict]:
+    """-> (params, state) matching ``XLANet.init``'s structure, filled
+    from a .caffemodel where layer names match; unmatched layers keep
+    no entry (caller merges over freshly-initialised values)."""
+    _, blobs = load_caffemodel(path_or_bytes)
+    params: Dict[str, Dict[str, np.ndarray]] = {}
+    state: Dict[str, Dict[str, np.ndarray]] = {}
+    for lp in net.layers:
+        lb = blobs.get(lp.name)
+        if not lb:
+            continue
+        t = lp.type
+        if t in ("Convolution", "Deconvolution"):
+            w = lb[0]
+            entry = {"weight": np.transpose(w, (2, 3, 1, 0))}  # OIHW->HWIO
+            if len(lb) > 1:
+                entry["bias"] = lb[1].reshape(-1)
+            params[lp.name] = entry
+        elif t == "InnerProduct":
+            w = _ip_rows_chw_to_hwc(lb[0], net.blob_shapes[lp.bottom[0]])
+            entry = {"weight": np.ascontiguousarray(w.T)}  # (in, out)
+            if len(lb) > 1:
+                entry["bias"] = lb[1].reshape(-1)
+            params[lp.name] = entry
+        elif t == "BatchNorm":
+            scale = float(lb[2].reshape(-1)[0]) if len(lb) > 2 else 1.0
+            scale = 1.0 / scale if scale != 0 else 0.0
+            state[lp.name] = {
+                "mean": lb[0].reshape(-1) * scale,
+                "var": lb[1].reshape(-1) * scale,
+            }
+        elif t in ("Scale", "Bias", "PReLU"):
+            entry = {"weight": lb[0].reshape(-1)}
+            if len(lb) > 1:
+                entry["bias"] = lb[1].reshape(-1)
+            params[lp.name] = entry
+        else:  # unknown parametric layer: keep caffe layout as-is
+            entry = {"weight": lb[0]}
+            if len(lb) > 1:
+                entry["bias"] = lb[1]
+            params[lp.name] = entry
+    return params, state
+
+
+def merge_into(params, imported) -> Dict:
+    """Overlay imported arrays (host numpy) onto an initialised
+    WeightCollection, preserving entries the model file lacks."""
+    out = {k: dict(v) for k, v in params.items()}
+    for layer, entry in imported.items():
+        if layer not in out:
+            out[layer] = {}
+        for name, arr in entry.items():
+            out[layer][name] = np.asarray(arr, np.float32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Writing
+# ---------------------------------------------------------------------------
+
+def _encode_blob(arr: np.ndarray) -> bytes:
+    shape_msg = b"".join(
+        wire.encode_varint_field(1, int(d)) for d in arr.shape
+    )
+    return (
+        wire.encode_packed_floats(5, arr.reshape(-1))
+        + wire.encode_bytes_field(7, shape_msg)
+    )
+
+
+def export_caffemodel(path: str, net, params, state=None) -> None:
+    """Write params (+BN state) as a binary NetParameter, inverting the
+    import transpositions so Caffe reads native layouts."""
+    out = [wire.encode_string_field(1, getattr(net.net, "name", "") or "")]
+    state = state or {}
+    for lp in net.layers:
+        entry = params.get(lp.name, {})
+        st = state.get(lp.name, {})
+        blobs: List[np.ndarray] = []
+        t = lp.type
+        if t in ("Convolution", "Deconvolution") and "weight" in entry:
+            blobs.append(
+                np.transpose(np.asarray(entry["weight"]), (3, 2, 0, 1))
+            )  # HWIO->OIHW
+            if "bias" in entry:
+                blobs.append(np.asarray(entry["bias"]))
+        elif t == "InnerProduct" and "weight" in entry:
+            w = np.asarray(entry["weight"]).T  # (out, in) rows HWC
+            bshape = net.blob_shapes[lp.bottom[0]]
+            if len(bshape) == 4:
+                _, h, wd, c = bshape
+                w = (
+                    w.reshape(w.shape[0], h, wd, c).transpose(0, 3, 1, 2)
+                    .reshape(w.shape[0], c * h * wd)
+                )  # rows back to CHW
+            blobs.append(w)
+            if "bias" in entry:
+                blobs.append(np.asarray(entry["bias"]))
+        elif t == "BatchNorm" and st:
+            blobs.extend(
+                [np.asarray(st["mean"]), np.asarray(st["var"]),
+                 np.asarray([1.0], np.float32)]
+            )
+        elif entry:
+            blobs.append(np.asarray(entry["weight"]))
+            if "bias" in entry:
+                blobs.append(np.asarray(entry["bias"]))
+        if not blobs:
+            continue
+        layer_msg = (
+            wire.encode_string_field(1, lp.name)
+            + wire.encode_string_field(2, lp.type)
+            + b"".join(
+                wire.encode_bytes_field(7, _encode_blob(b)) for b in blobs
+            )
+        )
+        out.append(wire.encode_bytes_field(100, layer_msg))
+    with open(path, "wb") as fh:
+        fh.write(b"".join(out))

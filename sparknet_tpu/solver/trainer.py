@@ -186,6 +186,7 @@ class Solver:
                 )
             else:
                 batch = next(batches)
+            batch = self._put_batch(batch)
             self.rng, step_rng = jax.random.split(self.rng)
             self.params, self.state, self.opt_state, metrics = self._train_step(
                 self.params,
@@ -230,6 +231,28 @@ class Solver:
         if feed is not None:
             self.align_feed(feed)
 
+    def load_weights(self, path: str) -> None:
+        """Caffe's ``--weights`` finetuning path: overlay a
+        ``.caffemodel``'s blobs (transposed to our layouts) onto the
+        initialised params/state; optimizer state is untouched."""
+        from ..proto import caffemodel as cm
+
+        imported, st = cm.import_caffemodel(path, self.train_net)
+        p = cm.merge_into(jax.device_get(self.params), imported)
+        s = cm.merge_into(jax.device_get(self.state), st)
+        self.params, self.state, self.opt_state = self._place_restored(
+            p, s, jax.device_get(self.opt_state)
+        )
+
+    def export_weights(self, path: str) -> None:
+        """Write current weights as a binary ``.caffemodel``."""
+        from ..proto import caffemodel as cm
+
+        cm.export_caffemodel(
+            path, self.train_net, jax.device_get(self.params),
+            jax.device_get(self.state),
+        )
+
     def align_feed(self, feed) -> None:
         """Advance a deterministic (seeded) feed past the batches a
         restored run already consumed, so resume is bit-identical to the
@@ -251,11 +274,18 @@ class Solver:
         to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
         return to_dev(params), to_dev(state), to_dev(opt_state)
 
+    def _put_batch(self, batch, train: bool = True):
+        """Placement hook for one iteration's host batch; the base
+        solver lets jit place it.  ParallelSolver overrides (mesh
+        shardings, multi-host global assembly)."""
+        return batch
+
     def test(self, batches: Iterator[Dict[str, Any]], test_iter: Optional[int] = None):
         n = test_iter or (self.sp.test_iter[0] if self.sp.test_iter else 1)
         acc: Dict[str, float] = {}
         for _ in range(n):
-            m = self._eval_step(self.params, self.state, next(batches))
+            batch = self._put_batch(next(batches), train=False)
+            m = self._eval_step(self.params, self.state, batch)
             for k, v in m.items():
                 acc[k] = acc.get(k, 0.0) + float(v)
         return {k: v / n for k, v in acc.items()}

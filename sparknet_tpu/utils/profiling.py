@@ -1,0 +1,126 @@
+"""Tracing / profiling subsystem (SURVEY.md §5).
+
+The reference's only observability is the Spark UI plus Caffe glog
+lines; on TPU the equivalents are XLA's profiler (op-level timeline in
+TensorBoard format) and step-level throughput/MFU counters, both
+exposed here:
+
+- :func:`trace` — context manager around ``jax.profiler.trace``; view
+  the dump with TensorBoard's profile plugin or xprof.
+- :class:`StepTimer` — windowed step-time / items-per-second / MFU
+  meter for app training loops (items = images or tokens).
+- :func:`compiled_flops` — actual per-execution FLOPs of a lowered
+  jitted function from XLA cost analysis (the bench.py MFU numerator).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+# bf16 peak FLOP/s per chip by device_kind substring (spec sheets).
+PEAK_TFLOPS = [
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """FLOPs per execution of ``jitted(*args)`` per XLA cost analysis;
+    None when the backend doesn't report."""
+    try:
+        cost = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """``with trace("/tmp/prof"):`` — no-op when log_dir is falsy."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class StepTimer:
+    """Windowed throughput meter for training loops.
+
+    >>> timer = StepTimer(items_per_step=batch_size, flops_per_step=f)
+    >>> ... run steps ...
+    >>> timer.update(n_steps)  # after a host sync
+    >>> timer.format()
+    'steps/s=12.3 images/s=1575 mfu=0.31'
+    """
+
+    def __init__(
+        self,
+        items_per_step: float = 0.0,
+        flops_per_step: Optional[float] = None,
+        unit: str = "items",
+        n_chips: int = 1,
+    ):
+        self.items_per_step = items_per_step
+        self.flops_per_step = flops_per_step
+        self.unit = unit
+        self.peak = device_peak_flops()
+        self.n_chips = max(1, n_chips)
+        self._t = time.perf_counter()
+        self.steps_per_sec = 0.0
+
+    def update(self, n_steps: int) -> "StepTimer":
+        now = time.perf_counter()
+        dt = max(now - self._t, 1e-9)
+        self._t = now
+        self.steps_per_sec = n_steps / dt
+        return self
+
+    @property
+    def items_per_sec(self) -> float:
+        return self.steps_per_sec * self.items_per_step
+
+    @property
+    def tflops(self) -> Optional[float]:
+        if self.flops_per_step is None:
+            return None
+        return self.steps_per_sec * self.flops_per_step / 1e12
+
+    @property
+    def mfu(self) -> Optional[float]:
+        t = self.tflops
+        if t is None or not self.peak:
+            return None
+        return t * 1e12 / (self.peak * self.n_chips)
+
+    def format(self) -> str:
+        parts = [f"steps/s={self.steps_per_sec:.2f}"]
+        if self.items_per_step:
+            parts.append(f"{self.unit}/s={self.items_per_sec:.0f}")
+        if self.tflops is not None:
+            parts.append(f"tflops={self.tflops:.1f}")
+        if self.mfu is not None:
+            parts.append(f"mfu={self.mfu:.3f}")
+        return " ".join(parts)

@@ -1,0 +1,80 @@
+"""Worker process for the multi-host CPU simulation test.
+
+Run as:  python _multihost_worker.py <coordinator> <process_id> <out.npz>
+
+Each of the 2 worker processes owns 2 virtual CPU devices; together
+they form one global 4-device dp mesh. Both feed only their host-local
+rows of the same deterministic global batches; process 0 saves the
+resulting params. The parent test compares against a single-process run
+over the identical global batches.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ZOO = os.path.join(REPO, "sparknet_tpu", "models", "prototxt")
+
+GLOBAL_BS = 8
+N_STEPS = 3
+
+
+def global_batches():
+    rng = np.random.default_rng(5)
+    return [
+        {
+            "data": rng.normal(size=(GLOBAL_BS, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, GLOBAL_BS).astype(np.int32),
+        }
+        for _ in range(N_STEPS)
+    ]
+
+
+def build_solver(mesh):
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.parallel import ParallelSolver
+
+    sp = caffe_pb.load_solver(os.path.join(ZOO, "cifar10_quick_solver.prototxt"))
+    sp.base_lr = 0.01
+    shapes = {"data": (GLOBAL_BS, 32, 32, 3), "label": (GLOBAL_BS,)}
+    return ParallelSolver(
+        sp, shapes, solver_dir=REPO, mesh=mesh, mode="sync"
+    )
+
+
+def main():
+    coord, pid, out = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    sys.path.insert(0, REPO)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sparknet_tpu.parallel import make_mesh, multihost
+
+    assert multihost.initialize(coord, 2, pid)
+    assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+    solver = build_solver(make_mesh({"dp": 4}))
+    lo, hi = pid * GLOBAL_BS // 2, (pid + 1) * GLOBAL_BS // 2
+
+    def feed():
+        for b in global_batches():
+            yield {k: v[lo:hi] for k, v in b.items()}  # host-local rows
+
+    m = solver.step(feed(), N_STEPS)
+    assert np.isfinite(float(m["loss"]))
+    if multihost.is_primary():
+        from sparknet_tpu.nets import weights as W
+
+        W.save_npz(out, jax.device_get(solver.params))
+    print(f"worker {pid}: done, loss={float(m['loss']):.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Caffe-native data sources: LMDB/Datum, ImageData, HDF5Data."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.caffe_layers import (
+    dataset_from_layer,
+    decode_datum,
+    encode_datum,
+    hdf5_dataset,
+    image_data_dataset,
+    lmdb_dataset,
+)
+from sparknet_tpu.data.lmdb_io import LMDBReader, write_lmdb
+from sparknet_tpu.proto import caffe_pb
+
+
+def test_lmdb_round_trip_small_values(tmp_path):
+    items = [(f"{i:08d}".encode(), f"value-{i}".encode() * 3) for i in range(50)]
+    path = str(tmp_path / "small.mdb")
+    write_lmdb(path, items)
+    got = list(LMDBReader(path).items())
+    assert got == sorted(items)
+
+
+def test_lmdb_round_trip_multi_leaf_and_overflow(tmp_path):
+    rng = np.random.default_rng(0)
+    items = []
+    for i in range(40):
+        if i % 5 == 0:  # big values -> overflow pages
+            val = rng.integers(0, 256, 9000, dtype=np.uint8).tobytes()
+        else:
+            val = rng.integers(0, 256, 500, dtype=np.uint8).tobytes()
+        items.append((f"k{i:06d}".encode(), val))
+    path = str(tmp_path / "big.mdb")
+    write_lmdb(path, items)
+    reader = LMDBReader(path)
+    assert len(reader) == 40
+    got = list(reader.items())
+    assert [k for k, _ in got] == [k for k, _ in sorted(items)]
+    for (k1, v1), (k2, v2) in zip(got, sorted(items)):
+        assert v1 == v2, k1
+
+
+def test_lmdb_directory_layout(tmp_path):
+    d = tmp_path / "db_dir"
+    d.mkdir()
+    write_lmdb(str(d), [(b"a", b"1")])
+    assert os.path.exists(d / "data.mdb")
+    assert list(LMDBReader(str(d)).items()) == [(b"a", b"1")]
+
+
+def test_datum_round_trip_uint8_and_float():
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, (8, 6, 3), dtype=np.uint8)
+    out, label = decode_datum(encode_datum(img, 7))
+    assert label == 7
+    np.testing.assert_array_equal(out, img)
+
+    imgf = rng.normal(size=(4, 5, 3)).astype(np.float32)
+    out, label = decode_datum(encode_datum(imgf, 2))
+    assert label == 2
+    np.testing.assert_allclose(out, imgf, rtol=1e-6)
+
+
+def test_lmdb_dataset_batches(tmp_path):
+    rng = np.random.default_rng(2)
+    imgs = rng.integers(0, 256, (30, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, 30)
+    items = [
+        (f"{i:08d}".encode(), encode_datum(imgs[i], int(labels[i])))
+        for i in range(30)
+    ]
+    path = str(tmp_path / "cifar.mdb")
+    write_lmdb(path, items)
+    ds = lmdb_dataset(path, num_partitions=4)
+    batch = next(ds.batches(8, shuffle=False))
+    assert batch["data"].shape == (8, 32, 32, 3)
+    np.testing.assert_array_equal(batch["data"], imgs[:8])
+    np.testing.assert_array_equal(batch["label"], labels[:8])
+
+
+def test_hdf5_dataset(tmp_path):
+    import h5py
+
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(12, 3, 8, 8)).astype(np.float32)  # NCHW
+    label = rng.integers(0, 5, 12)
+    h5 = str(tmp_path / "part0.h5")
+    with h5py.File(h5, "w") as f:
+        f["data"] = data
+        f["label"] = label
+    src = tmp_path / "files.txt"
+    src.write_text(h5 + "\n")
+    ds = hdf5_dataset(str(src))
+    part = ds.collect_partition(0)
+    assert part["data"].shape == (12, 8, 8, 3)
+    np.testing.assert_allclose(
+        part["data"], np.transpose(data, (0, 2, 3, 1)), rtol=1e-6
+    )
+    np.testing.assert_array_equal(part["label"], label)
+
+
+def test_image_data_dataset(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(4)
+    lines = []
+    for i in range(6):
+        arr = rng.integers(0, 256, (10, 12, 3), dtype=np.uint8)
+        p = tmp_path / f"img{i}.png"
+        Image.fromarray(arr).save(p)
+        lines.append(f"img{i}.png {i % 3}")
+    src = tmp_path / "list.txt"
+    src.write_text("\n".join(lines) + "\n")
+    ds = image_data_dataset(
+        str(src), root_folder=str(tmp_path), new_height=8, new_width=9
+    )
+    part = ds.collect_partition(0)
+    assert part["data"].shape == (6, 8, 9, 3)
+    np.testing.assert_array_equal(part["label"], [0, 1, 2, 0, 1, 2])
+
+
+def test_dataset_from_layer_lmdb(tmp_path):
+    rng = np.random.default_rng(5)
+    imgs = rng.integers(0, 256, (10, 32, 32, 3), dtype=np.uint8)
+    items = [
+        (f"{i:08d}".encode(), encode_datum(imgs[i], i % 10)) for i in range(10)
+    ]
+    db = str(tmp_path / "train_lmdb")
+    os.makedirs(db)
+    write_lmdb(db, items)
+    layer = caffe_pb.load_net(
+        f"""
+        name: "t"
+        layer {{ name: "d" type: "Data" top: "data" top: "label"
+                 data_param {{ source: "{db}" batch_size: 4 backend: LMDB }} }}
+        """,
+        is_path=False,
+    ).layers[0]
+    ds = dataset_from_layer(layer)
+    assert ds is not None
+    part = next(ds.batches(4, shuffle=False))
+    np.testing.assert_array_equal(part["data"], imgs[:4])
+
+    missing = caffe_pb.load_net(
+        """
+        name: "t"
+        layer { name: "d" type: "Data" top: "data" top: "label"
+                data_param { source: "/nonexistent_lmdb" batch_size: 4 } }
+        """,
+        is_path=False,
+    ).layers[0]
+    assert dataset_from_layer(missing) is None

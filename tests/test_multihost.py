@@ -1,0 +1,58 @@
+"""Multi-host layer (SURVEY.md §1 Deployment): 2-process CPU simulation
+must produce the exact global-batch semantics of a single process."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import pytest
+
+from tests import _multihost_worker as worker
+from sparknet_tpu.nets import weights as W
+from sparknet_tpu.parallel import make_mesh, multihost
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_helpers_single_process():
+    assert multihost.initialize() is False  # no coordinator -> no-op
+    assert multihost.is_primary()
+    assert multihost.process_count() == 1
+    ds_like = type("DS", (), {"shard": lambda *a: pytest.fail("sharded")})()
+    assert multihost.host_shard(ds_like) is ds_like  # identity at 1 proc
+
+
+def test_two_processes_match_single_process(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    out = str(tmp_path / "mh_params.npz")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker.__file__, coord, str(i), out],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in (0, 1)
+    ]
+    logs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    assert os.path.exists(out), logs[0]
+
+    # single-process reference over the SAME global batches
+    solver = worker.build_solver(make_mesh({"dp": 4}, jax.devices()[:4]))
+    solver.step(iter(worker.global_batches()), worker.N_STEPS)
+    ref = jax.device_get(solver.params)
+    got = W.load_npz(out)
+    for layer, ps in ref.items():
+        for name, arr in ps.items():
+            np.testing.assert_allclose(
+                got[layer][name], np.asarray(arr), rtol=2e-5, atol=1e-6,
+                err_msg=f"{layer}.{name}",
+            )
