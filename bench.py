@@ -44,10 +44,6 @@ from sparknet_tpu.utils.profiling import compiled_flops, device_peak_flops
 CAFFE_K40_ALEXNET_IMG_PER_SEC = 250.0  # "4 ms/image for learning"
 
 
-def _peak_flops(device) -> float | None:
-    return device_peak_flops(device)
-
-
 def _first_device():
     """Backend probe with CPU fallback — never raises on a dead tunnel."""
     try:
@@ -88,8 +84,10 @@ def bench_alexnet(platform: str) -> dict:
     solver = Solver(sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype)
 
     rng = np.random.default_rng(0)
-    end_to_end = bool(int(os.environ.get("BENCH_INPUT_PIPELINE", "0")))
+    pipeline_mode = os.environ.get("BENCH_INPUT_PIPELINE", "0")
+    end_to_end = pipeline_mode not in ("", "0")
     if end_to_end:
+        from sparknet_tpu.apps.cifar_app import make_native_feed
         from sparknet_tpu.apps.imagenet_app import make_feed
         from sparknet_tpu.data.imagenet import BGR_MEAN, imagenet_dataset
         from sparknet_tpu.data.preprocess import Transformer
@@ -98,7 +96,9 @@ def bench_alexnet(platform: str) -> dict:
         tf = Transformer(
             mean_values=list(BGR_MEAN), crop_size=227, mirror=True, train=True
         )
-        feed_iter = make_feed(ds, tf, bs, seed=0)
+        # "native" -> C++ threaded prefetch loader; else host-python path
+        make = make_native_feed if pipeline_mode == "native" else make_feed
+        feed_iter = make(ds, tf, bs, seed=0)
         feed = lambda: feed_iter
     else:
         batch = {
@@ -129,7 +129,7 @@ def bench_alexnet(platform: str) -> dict:
 
     img_per_sec = bs * iters / dt
     tflops = flops_batch * iters / dt / 1e12
-    peak = _peak_flops(jax.devices()[0])
+    peak = device_peak_flops(jax.devices()[0])
     return {
         "metric": "alexnet_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
@@ -141,7 +141,7 @@ def bench_alexnet(platform: str) -> dict:
         "step_ms": round(1000 * dt / iters, 2),
         "tflops": round(tflops, 2),
         "mfu": round(tflops * 1e12 / peak, 4) if peak else None,
-        "input_pipeline": end_to_end,
+        "input_pipeline": pipeline_mode if end_to_end else False,
     }
 
 
@@ -195,7 +195,7 @@ def bench_bert(platform: str) -> dict:
 
     tok_per_sec = bs * seq * iters / dt
     tflops = flops_batch * iters / dt / 1e12
-    peak = _peak_flops(jax.devices()[0])
+    peak = device_peak_flops(jax.devices()[0])
     return {
         "metric": "bert_base_mlm_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 2),

@@ -161,8 +161,19 @@ def main(argv=None) -> Dict[str, float]:
     )
     t0 = time.time()
     metrics = {}
-    profiler = trace(args.profile_dir)
-    profiler.__enter__()
+    with trace(args.profile_dir):
+        metrics = _fit(solver, feed, args, timer, primary)
+    dt = time.time() - t0
+    if primary:
+        print(
+            f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
+            f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
+        )
+    return metrics
+
+
+def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
+    metrics: Dict[str, float] = {}
     while solver.iter < args.max_iter:
         # stop at the nearest of: next display chunk, next snapshot
         # boundary, max_iter — so the cadences can't skip each other
@@ -172,6 +183,7 @@ def main(argv=None) -> Dict[str, float]:
             if interval:
                 targets.append((solver.iter // interval + 1) * interval)
         prev_iter = solver.iter
+        timer.update(0)  # reset: exclude snapshot/feed-setup wall time
         m = solver.step(
             feed, min(targets) - solver.iter,
             log_fn=lambda it, mm: primary and print(
@@ -191,13 +203,6 @@ def main(argv=None) -> Dict[str, float]:
             path = f"{args.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
             solver.save(path)
             print(f"Snapshotting solver state to {path}")
-    profiler.__exit__(None, None, None)
-    dt = time.time() - t0
-    if primary:
-        print(
-            f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
-            f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
-        )
     return metrics
 
 

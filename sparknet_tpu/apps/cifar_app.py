@@ -128,6 +128,22 @@ def build(args) -> tuple:
             data_dir, train=False, synthetic_n=args.synthetic_n
         )
 
+    # A mean regenerated from data must cover the FULL dataset and be
+    # computed once — before host sharding (all hosts must subtract the
+    # same mean) and shared by the train/test transformers.
+    def needs_regenerated_mean(layer):
+        tp = layer.transform_param if layer else None
+        if tp is None or tp.get("mean_file") is None:
+            return False
+        return not os.path.exists(
+            resolve_model_path(str(tp.get("mean_file")), solver_dir)
+        )
+
+    if mean is None and (
+        needs_regenerated_mean(train_layer) or needs_regenerated_mean(test_layer)
+    ):
+        mean = _dataset_mean(train_ds)
+
     # multi-host: each process feeds its shard; batch sizes in the
     # solver stay GLOBAL (prototxt semantics), feeds serve local rows
     nproc = jax.process_count()
@@ -156,9 +172,7 @@ def build(args) -> tuple:
 
                 t.mean_image = load_binaryproto_mean(mf)
             else:
-                t.mean_image = (
-                    mean if mean is not None else _dataset_mean(train_ds)
-                )
+                t.mean_image = mean  # precomputed full-dataset mean
         return t
 
     train_tf = transformer_for(train_layer, True)

@@ -27,7 +27,7 @@ from ..nets import weights as W
 from ..proto import caffe_pb
 from ..solver.trainer import Solver, resolve_model_path
 from ..parallel import ParallelSolver, make_mesh, multihost
-from .cifar_app import _batch_size, _data_layer, train_loop
+from .cifar_app import _batch_size, _data_layer, make_native_feed, train_loop
 
 ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "prototxt")
 
@@ -134,7 +134,10 @@ def build(args):
         )
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
-    train_feed = make_feed(train_ds, train_tf, feed_train_bs, seed=args.seed)
+    feed_fn = (
+        make_native_feed if getattr(args, "native_loader", False) else make_feed
+    )
+    train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     return solver, train_feed, test_feed
 
@@ -154,6 +157,8 @@ def parser() -> argparse.ArgumentParser:
                     default="none")
     ap.add_argument("--tau", type=int, default=10,
                     help="local-SGD sync period (the SparkNet τ knob)")
+    ap.add_argument("--native-loader", action="store_true",
+                    help="use the C++ prefetching data loader")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (TPU-native matmul dtype)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",

@@ -83,20 +83,32 @@ def encode_datum(img: np.ndarray, label: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 def lmdb_dataset(source: str, num_partitions: int = 8) -> ShardedDataset:
-    reader = LMDBReader(source)
-    images: List[np.ndarray] = []
-    labels: List[int] = []
-    for _, val in reader.items():
-        img, label = decode_datum(val)
-        images.append(img)
-        labels.append(label)
-    return ShardedDataset.from_arrays(
-        {
-            "data": np.stack(images),
-            "label": np.asarray(labels, np.int32),
-        },
-        num_partitions,
-    )
+    """Lazy partitions over leaf-page ranges: only the B-tree structure
+    is read up front; each partition closure decodes its own pages on
+    demand (lineage semantics; a host shard never decodes other hosts'
+    records)."""
+    pages = LMDBReader(source).leaf_pages()
+    per = max(1, -(-len(pages) // num_partitions))
+    chunks = [pages[i : i + per] for i in range(0, len(pages), per)]
+
+    def make(chunk):
+        def load() -> Dict[str, np.ndarray]:
+            reader = LMDBReader(source)
+            images: List[np.ndarray] = []
+            labels: List[int] = []
+            for pgno in chunk:
+                for _, val in reader.leaf_items(pgno):
+                    img, label = decode_datum(val)
+                    images.append(img)
+                    labels.append(label)
+            return {
+                "data": np.stack(images),
+                "label": np.asarray(labels, np.int32),
+            }
+
+        return load
+
+    return ShardedDataset([make(c) for c in chunks])
 
 
 def image_data_dataset(

@@ -109,6 +109,32 @@ class LMDBReader:
             return
         yield from self._walk(self.root)
 
+    def leaf_pages(self) -> List[int]:
+        """Leaf page numbers in key order — the unit of lazy
+        partitioning (ShardedDataset closures decode one page range
+        each instead of materialising the whole DB)."""
+        out: List[int] = []
+        if self.root == INVALID:
+            return out
+
+        def visit(pgno: int) -> None:
+            off, flags = self._page(pgno)
+            if flags & P_BRANCH:
+                for node in self._nodes(off):
+                    lo, hi, nflags, _ = struct.unpack_from(
+                        "<HHHH", self._buf, node
+                    )
+                    visit(lo | (hi << 16) | (nflags << 32))
+            else:
+                out.append(pgno)
+
+        visit(self.root)
+        return out
+
+    def leaf_items(self, pgno: int) -> Iterator[Tuple[bytes, bytes]]:
+        """(key, value) pairs of one leaf page."""
+        yield from self._walk(pgno)
+
     def __len__(self) -> int:
         return self.entries
 

@@ -240,9 +240,9 @@ class Solver:
         imported, st = cm.import_caffemodel(path, self.train_net)
         p = cm.merge_into(jax.device_get(self.params), imported)
         s = cm.merge_into(jax.device_get(self.state), st)
-        self.params, self.state, self.opt_state = self._place_restored(
-            p, s, jax.device_get(self.opt_state)
-        )
+        # opt_state untouched: it may be non-addressable (multi-host
+        # local mode), and finetuning starts with fresh optimizer slots
+        self.params, self.state, _ = self._place_restored(p, s, {})
 
     def export_weights(self, path: str) -> None:
         """Write current weights as a binary ``.caffemodel``."""

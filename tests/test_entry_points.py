@@ -66,7 +66,21 @@ def test_bench_alexnet_input_pipeline_mode(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_ITERS", "1")
     monkeypatch.setenv("BENCH_INPUT_PIPELINE", "1")
     rec = _run_bench(capsys)
-    assert rec["value"] > 0 and rec["input_pipeline"] is True
+    assert rec["value"] > 0 and rec["input_pipeline"] == "1"
+
+
+def test_bench_alexnet_native_pipeline_mode(monkeypatch, capsys):
+    from sparknet_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("BENCH_BATCH", "4")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    monkeypatch.setenv("BENCH_INPUT_PIPELINE", "native")
+    rec = _run_bench(capsys)
+    assert rec["value"] > 0 and rec["input_pipeline"] == "native"
 
 
 def test_bench_bert_emits_json(monkeypatch, capsys):
