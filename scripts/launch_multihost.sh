@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Multi-host cluster launcher — the reference's EC2/spark-submit role
+# (SURVEY.md §1 Deployment / §2 EC2-cluster scripts; mount empty).
+#
+# One identical invocation per host; host 0 doubles as coordinator:
+#
+#   ./scripts/launch_multihost.sh <num_hosts> <process_id> \
+#       [coordinator_host:port] -- <app args...>
+#
+# Examples:
+#   # host 0 of 4 (also the coordinator, default port 8476):
+#   ./scripts/launch_multihost.sh 4 0 -- \
+#       -m sparknet_tpu.apps.imagenet_app --arch alexnet --parallel sync --bf16
+#   # hosts 1..3: same command with process ids 1..3 and host 0's address
+#   ./scripts/launch_multihost.sh 4 2 host0:8476 -- \
+#       -m sparknet_tpu.apps.imagenet_app --arch alexnet --parallel sync --bf16
+#
+# Preemption recovery: append --auto-resume to the app args; every
+# relaunch resumes from the newest solverstate snapshot.
+set -euo pipefail
+
+NUM=${1:?num_hosts}
+PID=${2:?process_id}
+shift 2
+COORD="localhost:8476"
+if [[ "${1:-}" != "--" ]]; then
+  COORD=${1:?coordinator}
+  shift
+fi
+[[ "${1:-}" == "--" ]] && shift
+
+export SPARKNET_COORDINATOR="$COORD"
+export SPARKNET_NUM_PROCESSES="$NUM"
+export SPARKNET_PROCESS_ID="$PID"
+
+exec python "$@"

@@ -131,6 +131,9 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--snapshot-prefix", default="bert")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="resume from the newest snapshot-prefix "
+                         "solverstate if one exists (preemption recovery)")
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--seed", type=int, default=0)
@@ -141,6 +144,10 @@ def main(argv=None) -> Dict[str, float]:
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, feed, cfg = build(args)
+    if args.auto_resume:
+        from ..solver.snapshot import resolve_auto_resume
+
+        args.restore = resolve_auto_resume(args.snapshot_prefix, args.restore)
     if args.restore:
         solver.restore(args.restore, feed)
     primary = multihost.is_primary()

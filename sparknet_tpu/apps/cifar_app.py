@@ -298,6 +298,9 @@ def main(argv=None):
                     help="local-SGD sync period (the SparkNet τ knob)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="resume from the newest snapshot_prefix "
+                         "solverstate if one exists (preemption recovery)")
     ap.add_argument("--weights", default=None, metavar="CAFFEMODEL",
                     help="initialise weights from a .caffemodel (finetune)")
     ap.add_argument("--profile-dir", default=None,
@@ -307,6 +310,12 @@ def main(argv=None):
 
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
+    if args.auto_resume:
+        from ..solver.snapshot import resolve_auto_resume
+
+        args.restore = resolve_auto_resume(
+            solver.sp.snapshot_prefix or "", args.restore
+        )
     if args.restore:
         solver.restore(args.restore, train_feed)
     if multihost.is_primary():

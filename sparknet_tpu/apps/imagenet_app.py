@@ -163,6 +163,9 @@ def parser() -> argparse.ArgumentParser:
                     help="bfloat16 compute (TPU-native matmul dtype)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="resume from the newest snapshot_prefix "
+                         "solverstate if one exists (preemption recovery)")
     ap.add_argument("--weights", default=None, metavar="CAFFEMODEL",
                     help="initialise weights from a .caffemodel (finetune)")
     ap.add_argument("--profile-dir", default=None,
@@ -175,6 +178,12 @@ def main(argv=None):
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
+    if args.auto_resume:
+        from ..solver.snapshot import resolve_auto_resume
+
+        args.restore = resolve_auto_resume(
+            solver.sp.snapshot_prefix or "", args.restore
+        )
     if args.restore:
         solver.restore(args.restore, train_feed)
     if multihost.is_primary():

@@ -83,11 +83,28 @@ def encode_datum(img: np.ndarray, label: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 def lmdb_dataset(source: str, num_partitions: int = 8) -> ShardedDataset:
-    """Lazy partitions over leaf-page ranges: only the B-tree structure
-    is read up front; each partition closure decodes its own pages on
-    demand (lineage semantics; a host shard never decodes other hosts'
-    records)."""
-    pages = LMDBReader(source).leaf_pages()
+    """Lazy partitions over leaf-page ranges: the mmap'd reader touches
+    only the B-tree pages it walks, so each partition closure faults in
+    just its own records (lineage semantics; a host shard never decodes
+    other hosts' records).  DBs with fewer leaf pages than partitions
+    split by row ranges within the page list instead, so small DBs
+    still shard across every host."""
+    reader = LMDBReader(source)
+    pages = reader.leaf_pages()
+    if len(pages) < num_partitions:
+        # small DB: eager row split keeps every partition non-empty
+        images, labels = [], []
+        for _, val in reader.items():
+            img, label = decode_datum(val)
+            images.append(img)
+            labels.append(label)
+        return ShardedDataset.from_arrays(
+            {
+                "data": np.stack(images),
+                "label": np.asarray(labels, np.int32),
+            },
+            min(num_partitions, len(images)),
+        )
     per = max(1, -(-len(pages) // num_partitions))
     chunks = [pages[i : i + per] for i in range(0, len(pages), per)]
 

@@ -48,7 +48,16 @@ class LMDBReader:
         # Caffe opens the directory; the data file is data.mdb inside
         if os.path.isdir(path):
             path = os.path.join(path, "data.mdb")
-        self._buf = memoryview(open(path, "rb").read())
+        # mmap, not read(): construction touches only the meta pages,
+        # and a partition walk faults in only the pages it visits — so
+        # per-partition readers on a huge DB cost O(partition), not
+        # O(file)
+        import mmap
+
+        self._file = open(path, "rb")
+        self._buf = memoryview(
+            mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        )
         self.root, self.entries = self._pick_meta()
 
     def _pick_meta(self) -> Tuple[int, int]:

@@ -12,8 +12,11 @@ so restore needs no model to reconstruct shapes.
 
 from __future__ import annotations
 
+import glob
 import json
-from typing import Any, Dict
+import os
+import re
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -53,12 +56,67 @@ def _decode(spec: Any, leaves: Dict[str, np.ndarray]) -> Any:
 
 def save_state(path: str, **trees: Any) -> None:
     """Write named pytrees (nested dict/list/tuple of arrays and Python
-    scalars) to one npz. Device arrays are pulled to host."""
+    scalars) to one npz. Device arrays are pulled to host.  The write
+    is atomic (tmp + rename) so a preemption mid-snapshot can never
+    leave a truncated file for auto-resume to trip over."""
     leaves: list = []
     structure = {name: _encode(tree, leaves) for name, tree in trees.items()}
     meta = json.dumps({"version": FORMAT_VERSION, "structure": structure})
     arrays = {f"a{i}": leaf for i, leaf in enumerate(leaves)}
-    np.savez(path, **arrays, **{_META_KEY: np.frombuffer(meta.encode(), np.uint8)})
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(
+            fh, **arrays, **{_META_KEY: np.frombuffer(meta.encode(), np.uint8)}
+        )
+    os.replace(tmp, path)
+
+
+def latest_solverstate(prefix: str) -> Optional[str]:
+    """Highest-iteration ``{prefix}_iter_N.solverstate.npz`` on disk, or
+    None.  The auto-resume substrate: after a preemption, relaunching
+    with the same snapshot_prefix picks up exactly where training
+    stopped (the reference gets this from Spark task retry + Caffe
+    snapshots; SURVEY.md §5 elasticity)."""
+    best: Optional[str] = None
+    best_iter = -1
+    for path in glob.glob(f"{prefix}_iter_*.solverstate.npz"):
+        m = re.search(r"_iter_(\d+)\.solverstate\.npz$", path)
+        if m and int(m.group(1)) > best_iter:
+            best_iter = int(m.group(1))
+            best = path
+    return best
+
+
+def resolve_auto_resume(prefix: str, explicit: Optional[str]) -> Optional[str]:
+    """The apps' shared ``--auto-resume`` policy: an explicit --restore
+    wins; otherwise the newest solverstate under ``prefix``.  In
+    multi-host mode every process must restore the same snapshot —
+    process 0's choice is broadcast, and a host that cannot see the
+    file fails loudly (snapshots must live on shared storage) instead
+    of silently starting at iter 0 and deadlocking the collectives."""
+    if explicit:
+        return explicit
+    path = latest_solverstate(prefix or "")
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        it = -1
+        if path:
+            it = int(re.search(r"_iter_(\d+)", path).group(1))
+        it = int(multihost_utils.broadcast_one_to_all(np.asarray(it)))
+        if it < 0:
+            return None
+        cand = f"{prefix}_iter_{it}.solverstate.npz"
+        if not os.path.exists(cand):
+            raise FileNotFoundError(
+                f"process {jax.process_index()} cannot see {cand}; "
+                f"--auto-resume in multi-host mode requires snapshots on "
+                f"shared storage"
+            )
+        return cand
+    return path
 
 
 def load_state(path: str) -> Dict[str, Any]:

@@ -97,6 +97,17 @@ def test_resume_is_bit_identical(tmp_path, mode, tau):
     np.testing.assert_array_equal(np.asarray(s1.rng), np.asarray(s2.rng))
 
 
+def test_latest_solverstate(tmp_path):
+    prefix = str(tmp_path / "run")
+    assert snapshot.latest_solverstate(prefix) is None
+    for it in (2, 10, 6):
+        open(f"{prefix}_iter_{it}.solverstate.npz", "wb").close()
+    open(f"{prefix}_iter_99.npz", "wb").close()  # weights-only: ignored
+    assert snapshot.latest_solverstate(prefix) == (
+        f"{prefix}_iter_10.solverstate.npz"
+    )
+
+
 def test_cifar_app_restore_cli(tmp_path):
     """The CifarApp --restore flag end-to-end: snapshot at iter 2, resume
     to 4, matching the uninterrupted params exactly."""
@@ -127,3 +138,13 @@ def test_cifar_app_restore_cli(tmp_path):
     run(["--restore", f"{prefix}_iter_2.solverstate.npz"])
     p_resumed = W.load_npz(f"{prefix}_iter_4.npz")
     _assert_trees_equal(p_full, p_resumed)
+
+    # --auto-resume picks the newest remaining solverstate (iter 2 after
+    # the iter-4 one "is lost in the preemption") and re-reaches iter 4
+    import os
+
+    os.remove(f"{prefix}_iter_4.npz")
+    os.remove(f"{prefix}_iter_4.solverstate.npz")
+    run(["--auto-resume"])
+    p_auto = W.load_npz(f"{prefix}_iter_4.npz")
+    _assert_trees_equal(p_full, p_auto)
