@@ -144,10 +144,9 @@ def main(argv=None) -> Dict[str, float]:
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, feed, cfg = build(args)
-    if args.auto_resume:
-        from ..solver.snapshot import resolve_auto_resume
+    from ..solver.snapshot import apply_auto_resume
 
-        args.restore = resolve_auto_resume(args.snapshot_prefix, args.restore)
+    apply_auto_resume(args, args.snapshot_prefix)
     if args.restore:
         solver.restore(args.restore, feed)
     primary = multihost.is_primary()

@@ -310,12 +310,9 @@ def main(argv=None):
 
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
-    if args.auto_resume:
-        from ..solver.snapshot import resolve_auto_resume
+    from ..solver.snapshot import apply_auto_resume
 
-        args.restore = resolve_auto_resume(
-            solver.sp.snapshot_prefix or "", args.restore
-        )
+    apply_auto_resume(args, solver.sp.snapshot_prefix)
     if args.restore:
         solver.restore(args.restore, train_feed)
     if multihost.is_primary():

@@ -91,6 +91,8 @@ def lmdb_dataset(source: str, num_partitions: int = 8) -> ShardedDataset:
     still shard across every host."""
     reader = LMDBReader(source)
     pages = reader.leaf_pages()
+    if not pages:
+        raise ValueError(f"empty LMDB {source!r}")
     if len(pages) < num_partitions:
         # small DB: eager row split keeps every partition non-empty
         images, labels = [], []
@@ -128,13 +130,9 @@ def lmdb_dataset(source: str, num_partitions: int = 8) -> ShardedDataset:
     return ShardedDataset([make(c) for c in chunks])
 
 
-def image_data_dataset(
-    source: str,
-    root_folder: str = "",
-    new_height: int = 0,
-    new_width: int = 0,
-    files_per_part: int = 512,
-) -> ShardedDataset:
+def read_image_list(source: str, root_folder: str = "") -> List[Tuple[str, int]]:
+    """Caffe listfile (``<path> <label>`` per line) -> [(abs path, label)].
+    Shared by the ImageData layer and the convert_imageset tool."""
     entries: List[Tuple[str, int]] = []
     for line in open(source):
         line = line.strip()
@@ -142,6 +140,17 @@ def image_data_dataset(
             continue
         pth, _, lab = line.rpartition(" ")
         entries.append((os.path.join(root_folder, pth), int(lab)))
+    return entries
+
+
+def image_data_dataset(
+    source: str,
+    root_folder: str = "",
+    new_height: int = 0,
+    new_width: int = 0,
+    files_per_part: int = 512,
+) -> ShardedDataset:
+    entries = read_image_list(source, root_folder)
 
     def make(chunk):
         def load() -> Dict[str, np.ndarray]:

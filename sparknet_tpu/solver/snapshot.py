@@ -104,7 +104,9 @@ def resolve_auto_resume(prefix: str, explicit: Optional[str]) -> Optional[str]:
 
         it = -1
         if path:
-            it = int(re.search(r"_iter_(\d+)", path).group(1))
+            it = int(
+                re.search(r"_iter_(\d+)\.solverstate\.npz$", path).group(1)
+            )
         it = int(multihost_utils.broadcast_one_to_all(np.asarray(it)))
         if it < 0:
             return None
@@ -117,6 +119,13 @@ def resolve_auto_resume(prefix: str, explicit: Optional[str]) -> Optional[str]:
             )
         return cand
     return path
+
+
+def apply_auto_resume(args, prefix: str) -> None:
+    """App-side wiring: honour ``--auto-resume`` by filling
+    ``args.restore`` from the shared policy."""
+    if getattr(args, "auto_resume", False):
+        args.restore = resolve_auto_resume(prefix or "", args.restore)
 
 
 def load_state(path: str) -> Dict[str, Any]:

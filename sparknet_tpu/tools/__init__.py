@@ -1,0 +1,4 @@
+"""Caffe tool-chain twins: the CLI utilities the reference's workflow
+leans on (``convert_imageset``, ``compute_image_mean``, classification)
+re-implemented over this framework's codecs (SURVEY.md §2 data
+loaders / prototxt zoo; mount empty, no file:line)."""

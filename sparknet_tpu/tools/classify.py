@@ -1,0 +1,108 @@
+"""classify — deploy-prototxt inference over a .caffemodel.
+
+The reference era's ``classification.cpp`` / ``classify.py`` workflow:
+load a deploy NetParameter, overlay trained weights, preprocess images
+(resize, BGR, mean subtract) and report top-k classes.
+
+    python -m sparknet_tpu.tools.classify \
+        --model deploy.prototxt --weights model.caffemodel \
+        [--mean mean.binaryproto] [--labels synset_words.txt] img.jpg...
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def load_model(model: str, weights: Optional[str] = None, batch: int = 1):
+    from ..nets.xlanet import XLANet
+    from ..proto import caffe_pb
+
+    net_param = caffe_pb.load_net(model)
+    net = XLANet(net_param, "TEST")
+    params, state = net.init(jax.random.PRNGKey(0))
+    if weights:
+        from ..proto import caffemodel as cm
+
+        imported, st = cm.import_caffemodel(weights, net)
+        params = jax.tree_util.tree_map(
+            jnp.asarray, cm.merge_into(jax.device_get(params), imported)
+        )
+        if st:
+            state = jax.tree_util.tree_map(
+                jnp.asarray, cm.merge_into(jax.device_get(state), st)
+            )
+    return net, params, state
+
+
+def preprocess(
+    paths: List[str], size: int, mean_hwc: Optional[np.ndarray]
+) -> np.ndarray:
+    from PIL import Image
+
+    out = []
+    for p in paths:
+        img = Image.open(p).convert("RGB").resize((size, size), Image.BILINEAR)
+        arr = np.asarray(img, np.float32)[:, :, ::-1]  # BGR, Caffe order
+        if mean_hwc is not None:
+            arr = arr - mean_hwc
+        out.append(arr)
+    return np.stack(out)
+
+
+def classify(net, params, state, batch_hwc: np.ndarray, top_k: int = 5):
+    """-> (indices (N, top_k), probs (N, top_k)) from the net's final
+    blob (softmaxed here if the deploy net ends in logits)."""
+    name = net.input_names[0] if net.input_names else "data"
+    blobs, _ = net.apply(
+        params, state, {name: jnp.asarray(batch_hwc)}, train=False, rng=None
+    )
+    last = net.layers[-1]
+    out = np.asarray(blobs[last.top[0]], np.float64)
+    if last.type not in ("Softmax",):
+        out = np.exp(out - out.max(-1, keepdims=True))
+        out = out / out.sum(-1, keepdims=True)
+    idx = np.argsort(-out, axis=-1)[:, :top_k]
+    return idx, np.take_along_axis(out, idx, axis=-1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="deploy-net image classification")
+    ap.add_argument("--model", required=True, help="deploy .prototxt")
+    ap.add_argument("--weights", default=None, help=".caffemodel")
+    ap.add_argument("--mean", default=None, help="mean .binaryproto")
+    ap.add_argument("--labels", default=None, help="one label per line")
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("images", nargs="+")
+    args = ap.parse_args(argv)
+
+    net, params, state = load_model(args.model, args.weights)
+    name = net.input_names[0] if net.input_names else "data"
+    size = net.blob_shapes[name][1]
+    mean = None
+    if args.mean:
+        from ..proto.caffemodel import load_binaryproto_mean
+
+        mean = load_binaryproto_mean(args.mean)
+    labels = None
+    if args.labels:
+        labels = [l.strip() for l in open(args.labels)]
+
+    batch = preprocess(args.images, size, mean)
+    idx, probs = classify(net, params, state, batch, args.top_k)
+    for img, row_i, row_p in zip(args.images, idx, probs):
+        print(f"{img}:")
+        for i, p in zip(row_i, row_p):
+            label = labels[i] if labels and i < len(labels) else str(i)
+            print(f"  {p:.4f} {label}")
+    return idx, probs
+
+
+if __name__ == "__main__":
+    main()

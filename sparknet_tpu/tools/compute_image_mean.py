@@ -1,0 +1,62 @@
+"""compute_image_mean — LMDB -> mean .binaryproto.
+
+Twin of Caffe's ``tools/compute_image_mean``: averages every Datum in
+an LMDB and writes the per-pixel mean as a BlobProto ``.binaryproto``
+(CHW float data + legacy num/channels/height/width dims), byte-
+compatible with what ``transform_param.mean_file`` expects.
+
+    python -m sparknet_tpu.tools.compute_image_mean train_lmdb mean.binaryproto
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from ..proto import wire
+
+
+def compute_mean(db_path: str) -> np.ndarray:
+    """(H, W, C) float32 mean over all records."""
+    from ..data.caffe_layers import decode_datum
+    from ..data.lmdb_io import LMDBReader
+
+    total = None
+    n = 0
+    for _, val in LMDBReader(db_path).items():
+        img, _ = decode_datum(val)
+        img = img.astype(np.float64)
+        total = img if total is None else total + img
+        n += 1
+    if n == 0:
+        raise ValueError(f"empty LMDB {db_path!r}")
+    return (total / n).astype(np.float32)
+
+
+def write_binaryproto(path: str, mean_hwc: np.ndarray) -> None:
+    chw = np.transpose(mean_hwc, (2, 0, 1))
+    c, h, w = chw.shape
+    payload = (
+        wire.encode_varint_field(1, 1)  # num
+        + wire.encode_varint_field(2, c)
+        + wire.encode_varint_field(3, h)
+        + wire.encode_varint_field(4, w)
+        + wire.encode_packed_floats(5, chw.reshape(-1))
+    )
+    with open(path, "wb") as fh:
+        fh.write(payload)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="LMDB -> mean .binaryproto")
+    ap.add_argument("db")
+    ap.add_argument("out")
+    args = ap.parse_args(argv)
+    mean = compute_mean(args.db)
+    write_binaryproto(args.out, mean)
+    print(f"Wrote {args.out} shape={tuple(mean.shape)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""convert_imageset — build a Caffe-format LMDB from an image list.
+
+Twin of Caffe's ``tools/convert_imageset``: reads ``<path> <label>``
+lines, encodes each image as a ``Datum`` (raw CHW bytes, BGR channel
+order like Caffe's OpenCV path) and writes the LMDB the ``Data`` layer
+reads.
+
+    python -m sparknet_tpu.tools.convert_imageset \
+        --root /data/imgs --listfile train.txt --out train_lmdb \
+        --resize-height 256 --resize-width 256 [--shuffle]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def convert(
+    listfile: str,
+    out: str,
+    root: str = "",
+    resize_height: int = 0,
+    resize_width: int = 0,
+    shuffle: bool = False,
+    seed: int = 0,
+) -> int:
+    from ..data.caffe_layers import encode_datum, read_image_list
+    from ..data.lmdb_io import write_lmdb
+
+    entries = read_image_list(listfile, root)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(entries)
+
+    from PIL import Image
+
+    items = []
+    for i, (path, label) in enumerate(entries):
+        img = Image.open(path).convert("RGB")
+        if resize_height and resize_width:
+            img = img.resize((resize_width, resize_height), Image.BILINEAR)
+        arr = np.asarray(img, np.uint8)[:, :, ::-1]  # RGB -> BGR (Caffe)
+        # caffe keys: zero-padded index + filename
+        key = f"{i:08d}_{os.path.basename(path)}".encode()
+        items.append((key, encode_datum(arr, label)))
+    os.makedirs(out, exist_ok=True)
+    write_lmdb(out, items)
+    return len(items)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="image list -> Caffe LMDB")
+    ap.add_argument("--listfile", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--root", default="")
+    ap.add_argument("--resize-height", type=int, default=0)
+    ap.add_argument("--resize-width", type=int, default=0)
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    n = convert(
+        args.listfile, args.out, args.root, args.resize_height,
+        args.resize_width, args.shuffle, args.seed,
+    )
+    print(f"Processed {n} files.")
+    return n
+
+
+if __name__ == "__main__":
+    main()
