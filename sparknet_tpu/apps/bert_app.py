@@ -201,14 +201,11 @@ def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
         if primary and args.display:
             print(f"    speed: {timer.update(solver.iter - prev_iter).format()}")
         at_end = solver.iter >= args.max_iter
-        if (
-            args.snapshot
-            and primary
-            and (solver.iter % args.snapshot == 0 or at_end)
-        ):
+        if args.snapshot and (solver.iter % args.snapshot == 0 or at_end):
             path = f"{args.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
-            solver.save(path)
-            print(f"Snapshotting solver state to {path}")
+            solver.save(path)  # collective; process 0 writes
+            if primary:
+                print(f"Snapshotting solver state to {path}")
     return metrics
 
 

@@ -258,13 +258,15 @@ def train_loop(
         if (
             sp.snapshot
             and sp.snapshot_prefix
-            and multihost.is_primary()
             and (solver.iter % sp.snapshot == 0 or at_end)
         ):
             path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
-            W.save_npz(path, solver.params)
             state_path = f"{sp.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
+            # collective (gathers host-sharded optimizer slots); every
+            # process participates, only process 0 writes the files
             solver.save(state_path)
+            if multihost.is_primary():
+                W.save_npz(path, solver.params)
             log(f"Snapshotting to {path}")
             log(f"Snapshotting solver state to {state_path}")
     dt = time.time() - t0

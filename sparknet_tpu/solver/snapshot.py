@@ -24,6 +24,24 @@ FORMAT_VERSION = 1
 _META_KEY = "__solverstate__"
 
 
+def _to_host(x: Any) -> np.ndarray:
+    """Device -> host, gathering leaves that span other hosts' devices
+    (e.g. τ-local-SGD's dp-sharded optimizer slots).  The gather is a
+    collective: in multi-host mode EVERY process must reach save_state.
+    Replicated leaves skip it — each host already holds a full copy."""
+    import jax
+
+    if (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.is_fully_replicated
+    ):
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def _encode(obj: Any, leaves: list) -> Any:
     if isinstance(obj, dict):
         return {"t": "dict", "k": {str(k): _encode(v, leaves) for k, v in obj.items()}}
@@ -36,7 +54,7 @@ def _encode(obj: Any, leaves: list) -> Any:
         return {"t": "none"}
     if isinstance(obj, (bool, int, float, str)):
         return {"t": "py", "v": obj}
-    leaves.append(np.asarray(obj))
+    leaves.append(_to_host(obj))
     return {"t": "leaf", "i": len(leaves) - 1}
 
 
@@ -56,11 +74,21 @@ def _decode(spec: Any, leaves: Dict[str, np.ndarray]) -> Any:
 
 def save_state(path: str, **trees: Any) -> None:
     """Write named pytrees (nested dict/list/tuple of arrays and Python
-    scalars) to one npz. Device arrays are pulled to host.  The write
-    is atomic (tmp + rename) so a preemption mid-snapshot can never
-    leave a truncated file for auto-resume to trip over."""
+    scalars) to one npz. Device arrays are pulled to host — with a
+    cross-host gather for non-addressable leaves, so in multi-host mode
+    this must run on EVERY process; only process 0 touches the disk.
+    The write is atomic (tmp + rename) so a preemption mid-snapshot can
+    never leave a truncated file for auto-resume to trip over."""
     leaves: list = []
     structure = {name: _encode(tree, leaves) for name, tree in trees.items()}
+    try:
+        import jax
+
+        primary = jax.process_index() == 0
+    except Exception:
+        primary = True
+    if not primary:
+        return
     meta = json.dumps({"version": FORMAT_VERSION, "structure": structure})
     arrays = {f"a{i}": leaf for i, leaf in enumerate(leaves)}
     tmp = path + ".tmp"

@@ -32,7 +32,7 @@ def global_batches():
     ]
 
 
-def build_solver(mesh):
+def build_solver(mesh, mode="sync", tau=1):
     from sparknet_tpu.proto import caffe_pb
     from sparknet_tpu.parallel import ParallelSolver
 
@@ -40,12 +40,13 @@ def build_solver(mesh):
     sp.base_lr = 0.01
     shapes = {"data": (GLOBAL_BS, 32, 32, 3), "label": (GLOBAL_BS,)}
     return ParallelSolver(
-        sp, shapes, solver_dir=REPO, mesh=mesh, mode="sync"
+        sp, shapes, solver_dir=REPO, mesh=mesh, mode=mode, tau=tau
     )
 
 
 def main():
     coord, pid, out = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "sync"
     flags = os.environ.get("XLA_FLAGS", "")
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=2"
@@ -60,7 +61,9 @@ def main():
     assert multihost.initialize(coord, 2, pid)
     assert jax.device_count() == 4 and jax.local_device_count() == 2
 
-    solver = build_solver(make_mesh({"dp": 4}))
+    solver = build_solver(
+        make_mesh({"dp": 4}), mode=mode, tau=2 if mode == "local" else 1
+    )
     lo, hi = pid * GLOBAL_BS // 2, (pid + 1) * GLOBAL_BS // 2
 
     def feed():
@@ -69,7 +72,11 @@ def main():
 
     m = solver.step(feed(), N_STEPS)
     assert np.isfinite(float(m["loss"]))
-    if multihost.is_primary():
+    if mode == "local":
+        # collective snapshot: gathers the dp-sharded optimizer slots
+        # across hosts; every process calls, process 0 writes
+        solver.save(out + ".solverstate.npz")
+    elif multihost.is_primary():
         from sparknet_tpu.nets import weights as W
 
         W.save_npz(out, jax.device_get(solver.params))

@@ -29,20 +29,25 @@ def test_helpers_single_process():
     assert multihost.host_shard(ds_like) is ds_like  # identity at 1 proc
 
 
-def test_two_processes_match_single_process(tmp_path):
+def _run_cluster(out, mode="sync"):
     coord = f"127.0.0.1:{_free_port()}"
-    out = str(tmp_path / "mh_params.npz")
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, worker.__file__, coord, str(i), out],
+            [sys.executable, worker.__file__, coord, str(i), out, mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         for i in (0, 1)
     ]
     logs = [p.communicate(timeout=600)[0].decode() for p in procs]
     assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    return logs
+
+
+def test_two_processes_match_single_process(tmp_path):
+    out = str(tmp_path / "mh_params.npz")
+    logs = _run_cluster(out, "sync")
     assert os.path.exists(out), logs[0]
 
     # single-process reference over the SAME global batches
@@ -56,3 +61,26 @@ def test_two_processes_match_single_process(tmp_path):
                 got[layer][name], np.asarray(arr), rtol=2e-5, atol=1e-6,
                 err_msg=f"{layer}.{name}",
             )
+
+
+def test_local_mode_collective_snapshot(tmp_path):
+    """τ-local SGD across 2 processes: optimizer slots are dp-sharded
+    across hosts; a snapshot must gather them collectively and still
+    restore into a single-process solver."""
+    from sparknet_tpu.solver import snapshot as snap
+
+    out = str(tmp_path / "mh_local")
+    _run_cluster(out, "local")
+    path = out + ".solverstate.npz"
+    assert os.path.exists(path)
+    st = snap.load_state(path)
+    assert st["it"] == worker.N_STEPS
+    # local-mode slots carry the per-dp-slice leading axis (dp=4)
+    mom = st["opt_state"]["momentum"]["conv1"]["weight"]
+    assert mom.shape[0] == 4
+    # and the gathered state restores into a fresh single-process solver
+    solver = worker.build_solver(
+        make_mesh({"dp": 4}, jax.devices()[:4]), mode="local", tau=2
+    )
+    solver.restore(path)
+    assert solver.iter == worker.N_STEPS
