@@ -59,9 +59,23 @@ def make_args(**overrides) -> argparse.Namespace:
 
 
 def build(args):
+    import dataclasses
+
     cfg = CONFIGS[args.config]()
+    overrides = {}
     if args.vocab_size:
-        cfg = type(cfg)(**{**cfg.__dict__, "vocab_size": args.vocab_size})
+        overrides["vocab_size"] = args.vocab_size
+    if args.moe_experts:
+        overrides.update(
+            moe_num_experts=args.moe_experts,
+            moe_top_k=args.moe_top_k,
+            moe_dispatch=args.moe_dispatch,
+            moe_capacity_factor=args.moe_capacity,
+        )
+    if args.remat:
+        overrides["remat"] = True
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     seq = args.seq_len or min(128, cfg.max_position)
     bs = args.batch_size
     max_preds = max(1, int(seq * 0.15) + 1)
@@ -126,6 +140,18 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--tau", type=int, default=10)
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--attention", choices=("flash", "reference"), default=None)
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="replace dense FFNs with an N-expert MoE")
+    ap.add_argument("--moe-top-k", type=int, default=1)
+    ap.add_argument("--moe-dispatch", choices=("dense", "sort"),
+                    default="sort",
+                    help="sort = O(tokens) dispatch (use at scale); "
+                         "dense = one-hot einsums (small models)")
+    ap.add_argument("--moe-capacity", type=float, default=1.25,
+                    help="per-expert capacity factor")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialise encoder layers (activation "
+                         "memory ~ O(1) in depth; long-context knob)")
     ap.add_argument("--snapshot", type=int, default=0,
                     help="snapshot solver state every N iters")
     ap.add_argument("--snapshot-prefix", default="bert")

@@ -45,6 +45,18 @@ class BertConfig:
     attention_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
+    # Mixture-of-Experts FFN (0 experts = dense FFN). Routed through
+    # parallel/moe.py; aux (load-balance + z) loss joins the MLM loss
+    # with weight moe_aux_weight.
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_z_loss: float = 1e-3
+    moe_aux_weight: float = 0.01
+    moe_dispatch: str = "dense"
+    # rematerialise each encoder layer (trade FLOPs for activation
+    # memory — the long-context knob)
+    remat: bool = False
 
     @classmethod
     def bert_base(cls) -> "BertConfig":
@@ -137,12 +149,22 @@ class BertMLM:
         # arrive sharded (column-parallel qkv/ffn_in, row-parallel
         # out/ffn_out) and row-parallel projections psum over this axis
         tp_axis: Optional[str] = None,
+        # set inside shard_map over an expert-parallel axis: MoE expert
+        # stacks arrive sharded on their leading (expert) dim
+        ep_axis: Optional[str] = None,
     ):
         self.cfg = config
         self.compute_dtype = compute_dtype
         self.attention_impl = attention_impl
         self.sp_axis = sp_axis
         self.tp_axis = tp_axis
+        self.ep_axis = ep_axis
+        if config.moe_num_experts > 0:
+            if tp_axis is not None or attention_impl in ("ring", "ulysses"):
+                raise NotImplementedError(
+                    "MoE FFN composes with dp/ep; tp and sequence-parallel "
+                    "attention are not wired to the expert path yet"
+                )
         if "input_ids" not in input_shapes:
             raise ValueError("input_shapes must provide 'input_ids' (B, S)")
         b, s = input_shapes["input_ids"]
@@ -193,7 +215,7 @@ class BertMLM:
             }
         }
         for li in range(cfg.num_layers):
-            params[f"layer_{li:02d}"] = {
+            layer = {
                 "q_w": trunc(next(keys), (h, h)),
                 "q_b": jnp.zeros((h,), jnp.float32),
                 "k_w": trunc(next(keys), (h, h)),
@@ -204,13 +226,28 @@ class BertMLM:
                 "out_b": jnp.zeros((h,), jnp.float32),
                 "attn_ln_scale": jnp.ones((h,), jnp.float32),
                 "attn_ln_bias": jnp.zeros((h,), jnp.float32),
-                "ffn_in_w": trunc(next(keys), (h, i_sz)),
-                "ffn_in_b": jnp.zeros((i_sz,), jnp.float32),
-                "ffn_out_w": trunc(next(keys), (i_sz, h)),
-                "ffn_out_b": jnp.zeros((h,), jnp.float32),
                 "ffn_ln_scale": jnp.ones((h,), jnp.float32),
                 "ffn_ln_bias": jnp.zeros((h,), jnp.float32),
             }
+            if cfg.moe_num_experts > 0:
+                from ..parallel.moe import init_moe_params
+
+                layer.update(
+                    init_moe_params(
+                        next(keys), h, i_sz, cfg.moe_num_experts,
+                        std=cfg.initializer_range,
+                    )
+                )
+            else:
+                layer.update(
+                    {
+                        "ffn_in_w": trunc(next(keys), (h, i_sz)),
+                        "ffn_in_b": jnp.zeros((i_sz,), jnp.float32),
+                        "ffn_out_w": trunc(next(keys), (i_sz, h)),
+                        "ffn_out_b": jnp.zeros((h,), jnp.float32),
+                    }
+                )
+            params[f"layer_{li:02d}"] = layer
         params["mlm_head"] = {
             "dense_w": trunc(next(keys), (h, h)),
             "dense_b": jnp.zeros((h,), jnp.float32),
@@ -248,17 +285,39 @@ class BertMLM:
         return x, kv_mask, rng
 
     def encode(self, params, batch, *, train: bool, rng):
+        x, _ = self.encode_with_aux(params, batch, train=train, rng=rng)
+        return x
+
+    def encode_with_aux(self, params, batch, *, train: bool, rng):
+        """(hidden states, aux loss): aux is the summed MoE router loss
+        (0.0 for dense-FFN configs)."""
         cfg = self.cfg
         x, kv_mask, rng = self.embed(params, batch, train=train, rng=rng)
 
+        def apply_one(lp, h, mask, lrng):
+            # train stays a Python bool (dropout branches on it), so it
+            # is closed over rather than passed through jax.checkpoint
+            return self.layer_apply_with_aux(lp, h, mask, lrng, train)
+
+        if cfg.remat:
+            apply_one = jax.checkpoint(apply_one)
+        aux_total = jnp.asarray(0.0, jnp.float32)
         for li in range(cfg.num_layers):
             lp = params[f"layer_{li:02d}"]
             lrng = jax.random.fold_in(rng, li) if rng is not None else None
-            x = self.layer_apply(lp, x, kv_mask, rng=lrng, train=train)
-        return x
+            x, aux = apply_one(lp, x, kv_mask, lrng)
+            aux_total = aux_total + aux
+        return x, aux_total
 
     def layer_apply(self, lp, x, kv_mask, *, rng=None, train=False):
-        """One encoder layer (attention + FFN with post-LN residuals).
+        """One encoder layer; see :meth:`layer_apply_with_aux` (this is
+        the aux-less view pipeline parallelism scans over)."""
+        out, _ = self.layer_apply_with_aux(lp, x, kv_mask, rng, train)
+        return out
+
+    def layer_apply_with_aux(self, lp, x, kv_mask, rng=None, train=False):
+        """One encoder layer (attention + FFN with post-LN residuals),
+        returning (x, moe_aux).
 
         Factored out of :meth:`encode` so pipeline parallelism can scan
         a stage's stacked layer params through the identical math.
@@ -325,22 +384,40 @@ class BertMLM:
             x + attn_out, lp["attn_ln_scale"], lp["attn_ln_bias"],
             cfg.layer_norm_eps,
         ).astype(cdt)
-        ff_in = _tp_copy(x, tp) if tp is not None else x
-        ff = jax.nn.gelu(
-            proj(lp["ffn_in_w"], lp["ffn_in_b"], ff_in), approximate=True
-        )
-        ff = row_proj(lp["ffn_out_w"], lp["ffn_out_b"], ff)
+        aux = jnp.asarray(0.0, jnp.float32)
+        if "router_w" in lp:  # MoE FFN (dropped tokens ride the residual)
+            from ..parallel.moe import moe_ffn
+
+            moe_params = {
+                k: lp[k]
+                for k in ("router_w", "w_in", "b_in", "w_out", "b_out")
+            }
+            ff, aux = moe_ffn(
+                x, moe_params, ep_axis=self.ep_axis,
+                capacity_factor=cfg.moe_capacity_factor,
+                top_k=cfg.moe_top_k, z_loss_weight=cfg.moe_z_loss,
+                dispatch=cfg.moe_dispatch, compute_dtype=cdt,
+            )
+        else:
+            ff_in = _tp_copy(x, tp) if tp is not None else x
+            ff = jax.nn.gelu(
+                proj(lp["ffn_in_w"], lp["ffn_in_b"], ff_in), approximate=True
+            )
+            ff = row_proj(lp["ffn_out_w"], lp["ffn_out_b"], ff)
         ff = _dropout(ff, cfg.hidden_dropout, k2, train)
-        return _layer_norm(
+        out = _layer_norm(
             x + ff, lp["ffn_ln_scale"], lp["ffn_ln_bias"],
             cfg.layer_norm_eps,
         ).astype(cdt)
+        return out, aux
 
     # -- Solver protocol -----------------------------------------------------
     def apply(self, params, state, batch, *, train=None, rng=None):
         cfg = self.cfg
         train = bool(train)
-        x = self.encode(params, batch, train=train, rng=rng if train else None)
+        x, moe_aux = self.encode_with_aux(
+            params, batch, train=train, rng=rng if train else None
+        )
         b, s, h = x.shape
         pos = batch["mlm_positions"]  # (B, M)
         gathered = jnp.take_along_axis(x, pos[:, :, None], axis=1)  # (B,M,H)
@@ -367,6 +444,8 @@ class BertMLM:
         nll = -jnp.take_along_axis(logp, labels[:, :, None], axis=-1)[..., 0]
         denom = jnp.maximum(jnp.sum(weights), 1.0)
         loss = jnp.sum(nll * weights) / denom
+        if cfg.moe_num_experts > 0:
+            loss = loss + cfg.moe_aux_weight * moe_aux
         acc = jnp.sum(
             (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * weights
         ) / denom

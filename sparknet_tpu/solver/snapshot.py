@@ -24,11 +24,13 @@ FORMAT_VERSION = 1
 _META_KEY = "__solverstate__"
 
 
-def _to_host(x: Any) -> np.ndarray:
+def _to_host(x: Any, materialize: bool = True) -> np.ndarray:
     """Device -> host, gathering leaves that span other hosts' devices
     (e.g. τ-local-SGD's dp-sharded optimizer slots).  The gather is a
     collective: in multi-host mode EVERY process must reach save_state.
-    Replicated leaves skip it — each host already holds a full copy."""
+    Replicated leaves skip it — each host already holds a full copy,
+    and with ``materialize=False`` (non-primary processes, which never
+    write) they skip the device-to-host copy entirely."""
     import jax
 
     if (
@@ -38,23 +40,30 @@ def _to_host(x: Any) -> np.ndarray:
     ):
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    return np.asarray(x)
+        gathered = multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(gathered) if materialize else np.zeros(0)
+    return np.asarray(x) if materialize else np.zeros(0)
 
 
-def _encode(obj: Any, leaves: list) -> Any:
+def _encode(obj: Any, leaves: list, materialize: bool = True) -> Any:
     if isinstance(obj, dict):
-        return {"t": "dict", "k": {str(k): _encode(v, leaves) for k, v in obj.items()}}
+        return {
+            "t": "dict",
+            "k": {
+                str(k): _encode(v, leaves, materialize)
+                for k, v in obj.items()
+            },
+        }
     if isinstance(obj, (list, tuple)):
         return {
             "t": "tuple" if isinstance(obj, tuple) else "list",
-            "v": [_encode(v, leaves) for v in obj],
+            "v": [_encode(v, leaves, materialize) for v in obj],
         }
     if obj is None:
         return {"t": "none"}
     if isinstance(obj, (bool, int, float, str)):
         return {"t": "py", "v": obj}
-    leaves.append(_to_host(obj))
+    leaves.append(_to_host(obj, materialize))
     return {"t": "leaf", "i": len(leaves) - 1}
 
 
@@ -79,14 +88,16 @@ def save_state(path: str, **trees: Any) -> None:
     this must run on EVERY process; only process 0 touches the disk.
     The write is atomic (tmp + rename) so a preemption mid-snapshot can
     never leave a truncated file for auto-resume to trip over."""
-    leaves: list = []
-    structure = {name: _encode(tree, leaves) for name, tree in trees.items()}
-    try:
-        import jax
+    import jax
 
-        primary = jax.process_index() == 0
-    except Exception:
-        primary = True
+    primary = jax.process_index() == 0
+    leaves: list = []
+    # non-primary processes still walk every leaf IN THE SAME ORDER (the
+    # cross-host gathers are collectives) but skip host materialization
+    structure = {
+        name: _encode(tree, leaves, materialize=primary)
+        for name, tree in trees.items()
+    }
     if not primary:
         return
     meta = json.dumps({"version": FORMAT_VERSION, "structure": structure})
