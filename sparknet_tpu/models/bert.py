@@ -508,6 +508,7 @@ class BertMLM:
             nodecay = (
                 name.endswith("_b")
                 or name.endswith("_bias")
+                or name.startswith("b_")  # MoE expert biases b_in/b_out
                 or "ln_" in name
                 or name in ("output_bias",)
             )
@@ -517,11 +518,16 @@ class BertMLM:
             "embeddings": ["word", "position", "token_type", "ln_scale", "ln_bias"],
             "mlm_head": ["dense_w", "dense_b", "ln_scale", "ln_bias", "output_bias"],
         }
+        if self.cfg.moe_num_experts > 0:
+            ffn_names = ["router_w", "w_in", "b_in", "w_out", "b_out"]
+        else:
+            ffn_names = ["ffn_in_w", "ffn_in_b", "ffn_out_w", "ffn_out_b"]
         for li in range(self.cfg.num_layers):
             names[f"layer_{li:02d}"] = [
                 "q_w", "q_b", "k_w", "k_b", "v_w", "v_b", "out_w", "out_b",
-                "attn_ln_scale", "attn_ln_bias", "ffn_in_w", "ffn_in_b",
-                "ffn_out_w", "ffn_out_b", "ffn_ln_scale", "ffn_ln_bias",
+                "attn_ln_scale", "attn_ln_bias",
+                *ffn_names,
+                "ffn_ln_scale", "ffn_ln_bias",
             ]
         return {layer: {n: spec_for(n) for n in ns} for layer, ns in names.items()}
 

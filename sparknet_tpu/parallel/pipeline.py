@@ -107,6 +107,12 @@ def make_pp_train_step(
     (:func:`sparknet_tpu.data.text.mlm_feed_tokens`); its leading batch
     dim must divide ``n_micro`` (× dp).
     """
+    if getattr(getattr(model, "cfg", None), "moe_num_experts", 0) > 0:
+        raise NotImplementedError(
+            "pipeline parallelism is not wired to the MoE FFN path (the "
+            "stage pspecs and layer scan assume dense FFN params, and the "
+            "router aux loss would be dropped)"
+        )
     npp = mesh.shape[pp_axis]
     L = model.cfg.num_layers
     if L % npp:

@@ -1,0 +1,115 @@
+"""time_net — ``caffe time`` twin: benchmark a prototxt's train step.
+
+Reports average forward, forward+backward(+update) step time and
+throughput for a net/solver prototxt on the current backend, plus
+XLA-cost-analysis FLOPs and MFU when the backend reports them.
+
+    python -m sparknet_tpu.tools.time_net \
+        --solver .../cifar10_quick_solver.prototxt [--batch-size N] \
+        [--iters 50] [--bf16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def time_solver(solver, shapes, iters: int = 50, warmup: int = 3):
+    from ..utils.profiling import compiled_flops, device_peak_flops
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(
+            rng.integers(0, 10, size=shapes["label"]), jnp.int32
+        ),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    m = solver.step(feed(), warmup)
+    float(m["loss"])  # device fence
+
+    t0 = time.perf_counter()
+    m = solver.step(feed(), iters)
+    float(m["loss"])
+    train_dt = (time.perf_counter() - t0) / iters
+
+    # forward only (TEST-phase eval step), fenced once like the train
+    # loop so the two numbers share a methodology
+    m = solver._eval_step(solver.params, solver.state, batch)  # compile
+    float(next(iter(m.values())))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = solver._eval_step(solver.params, solver.state, batch)
+    float(next(iter(m.values())))
+    fwd_dt = (time.perf_counter() - t0) / iters
+
+    flops = compiled_flops(
+        solver._train_step, solver.params, solver.state, solver.opt_state,
+        batch, jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+    )
+    peak = device_peak_flops()
+    out = {
+        "platform": jax.devices()[0].platform,
+        "batch": shapes["data"][0],
+        "forward_ms": round(1000 * fwd_dt, 3),
+        "train_step_ms": round(1000 * train_dt, 3),
+        "items_per_sec": round(shapes["data"][0] / train_dt, 1),
+    }
+    if flops:
+        out["train_tflops"] = round(flops / train_dt / 1e12, 2)
+        if peak:
+            out["mfu"] = round(flops / train_dt / peak, 4)
+    return out
+
+
+def main(argv=None):
+    from ..proto import caffe_pb
+    from ..solver.trainer import Solver
+
+    ap = argparse.ArgumentParser(description="caffe-time twin")
+    ap.add_argument("--solver", required=True)
+    ap.add_argument("--batch-size", type=int, default=0)
+    ap.add_argument("--crop", type=int, default=0,
+                    help="input H=W (defaults to the net's data shape)")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--bf16", action="store_true")
+    args = ap.parse_args(argv)
+
+    sp = caffe_pb.load_solver(args.solver)
+    solver_dir = os.path.dirname(os.path.abspath(args.solver))
+    from ..apps.cifar_app import _batch_size, _data_layer
+    from ..solver.trainer import resolve_model_path
+
+    net_path = sp.net or sp.train_net
+    net_param = caffe_pb.load_net(resolve_model_path(net_path, solver_dir))
+    layer = _data_layer(net_param, "TRAIN")
+    bs = args.batch_size or _batch_size(layer, 32)
+    crop = args.crop
+    if not crop:
+        tp = layer.transform_param if layer else None
+        crop = int(tp.get("crop_size", 0)) if tp else 0
+    crop = crop or 32
+    shapes = {"data": (bs, crop, crop, 3), "label": (bs,)}
+    solver = Solver(
+        sp, shapes, net_param=net_param, solver_dir=solver_dir,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+    out = time_solver(solver, shapes, iters=args.iters)
+    for k, v in out.items():
+        print(f"{k}: {v}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,27 @@ def test_remat_matches_no_remat():
         )
 
 
+def test_moe_param_specs_no_decay_on_expert_biases():
+    model, _ = moe_model()
+    specs = model.param_specs()
+    layer = specs["layer_00"]
+    assert layer["b_in"] == (1.0, 0.0)
+    assert layer["b_out"] == (1.0, 0.0)
+    assert layer["router_w"] == (1.0, 1.0)
+    assert layer["w_in"] == (1.0, 1.0)
+    # spec names must cover exactly the real param names
+    params, _ = model.init(jax.random.PRNGKey(0))
+    assert set(layer) == set(params["layer_00"])
+
+
+def test_moe_bert_rejects_pipeline():
+    from sparknet_tpu.parallel.pipeline import make_pp_train_step
+
+    model, _ = moe_model()
+    with pytest.raises(NotImplementedError):
+        make_pp_train_step(model, None, None, n_micro=2)
+
+
 def test_moe_bert_rejects_tp_and_sp():
     cfg = dataclasses.replace(
         BertConfig.bert_tiny(vocab_size=64), moe_num_experts=4

@@ -135,6 +135,22 @@ layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
     assert (idx[:, 0] == truth).mean() >= 0.75
 
 
+def test_time_net_reports(tmp_path):
+    from sparknet_tpu.tools import time_net
+
+    out = time_net.main([
+        "--solver",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "sparknet_tpu", "models", "prototxt",
+            "cifar10_quick_solver.prototxt",
+        ),
+        "--batch-size", "8", "--iters", "3",
+    ])
+    assert out["train_step_ms"] > 0 and out["forward_ms"] > 0
+    assert out["items_per_sec"] > 0
+
+
 def cifar_app_args(solver_path, data_dir):
     import argparse
 
