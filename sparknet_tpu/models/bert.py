@@ -461,9 +461,24 @@ class BertMLM:
         ``(nll_sum, weight_sum, correct_sum)`` for the caller (the SP
         train step) to ``psum`` over the mesh.
         """
-        x = self.encode(params, batch, train=bool(train), rng=rng)
-        return self.token_loss_from_hidden(
-            params, x, batch["mlm_labels"], batch["mlm_weights"]
+        nll, w, corr, _ = self.token_loss_sums_with_aux(
+            params, state, batch, train=train, rng=rng
+        )
+        return nll, w, corr
+
+    def token_loss_sums_with_aux(
+        self, params, state, batch, *, train=False, rng=None
+    ):
+        """:meth:`token_loss_sums` plus the MoE router aux loss (0.0 for
+        dense configs) — the expert-parallel train step consumes it."""
+        x, aux = self.encode_with_aux(
+            params, batch, train=bool(train), rng=rng
+        )
+        return (
+            *self.token_loss_from_hidden(
+                params, x, batch["mlm_labels"], batch["mlm_weights"]
+            ),
+            aux,
         )
 
     def token_loss_from_hidden(self, params, x, labels, weights):

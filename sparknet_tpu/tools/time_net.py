@@ -54,17 +54,26 @@ def time_solver(solver, shapes, iters: int = 50, warmup: int = 3):
     float(next(iter(m.values())))
     fwd_dt = (time.perf_counter() - t0) / iters
 
+    # mirror Solver.step's batch layout: iter_size micro-batches stack
+    # on a leading axis (and each timed step consumes iter_size * bs)
+    iter_size = max(1, solver.sp.iter_size)
+    flops_batch = batch
+    if iter_size > 1:
+        flops_batch = jax.tree_util.tree_map(
+            lambda x: jnp.stack([x] * iter_size), batch
+        )
     flops = compiled_flops(
         solver._train_step, solver.params, solver.state, solver.opt_state,
-        batch, jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+        flops_batch, jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
     )
     peak = device_peak_flops()
+    items_per_step = shapes["data"][0] * iter_size
     out = {
         "platform": jax.devices()[0].platform,
         "batch": shapes["data"][0],
         "forward_ms": round(1000 * fwd_dt, 3),
         "train_step_ms": round(1000 * train_dt, 3),
-        "items_per_sec": round(shapes["data"][0] / train_dt, 1),
+        "items_per_sec": round(items_per_step / train_dt, 1),
     }
     if flops:
         out["train_tflops"] = round(flops / train_dt / 1e12, 2)
@@ -92,7 +101,12 @@ def main(argv=None):
     from ..solver.trainer import resolve_model_path
 
     net_path = sp.net or sp.train_net
-    net_param = caffe_pb.load_net(resolve_model_path(net_path, solver_dir))
+    if net_path:
+        net_param = caffe_pb.load_net(resolve_model_path(net_path, solver_dir))
+    elif sp.net_param is not None:  # inline net_param {...}
+        net_param = sp.net_param
+    else:
+        raise ValueError(f"{args.solver}: no net/train_net path or net_param")
     layer = _data_layer(net_param, "TRAIN")
     bs = args.batch_size or _batch_size(layer, 32)
     crop = args.crop
