@@ -41,17 +41,46 @@ def _dataset_mean(ds) -> np.ndarray:
 
 def _data_layer(net: caffe_pb.NetParameter, phase: str):
     for l in net.layers_for_phase(phase):
-        if l.type in ("Data", "Input", "MemoryData", "ImageData"):
+        if l.type in ("Data", "Input", "MemoryData", "ImageData", "HDF5Data"):
             return l
     return None
 
 
 def _batch_size(layer, default: int) -> int:
-    for field in ("data_param", "memory_data_param", "image_data_param"):
+    for field in (
+        "data_param", "memory_data_param", "image_data_param",
+        "hdf5_data_param",
+    ):
         sub = layer.sub(field) if layer else None
         if sub is not None and sub.get("batch_size") is not None:
             return int(sub.get("batch_size"))
     return default
+
+
+def make_transformer(layer, train: bool, solver_dir: str, fallback_mean=None):
+    """transform_param -> Transformer, resolving ``mean_file``: a real
+    .binaryproto wins; otherwise ``fallback_mean()`` supplies the mean
+    (per-pixel (H,W,C) image or per-channel vector).  Shared by both
+    image apps and the ``caffe test`` tool."""
+    t = Transformer.from_message(
+        layer.transform_param if layer else None, train=train
+    )
+    tp = layer.transform_param if layer else None
+    if tp is not None and tp.get("mean_file") is not None:
+        mf = resolve_model_path(str(tp.get("mean_file")), solver_dir)
+        if os.path.exists(mf):
+            from ..proto.caffemodel import load_binaryproto_mean
+
+            t.mean_image = load_binaryproto_mean(mf)
+        elif fallback_mean is not None:
+            m = fallback_mean()
+            if m is not None:
+                m = np.asarray(m, np.float32)
+                if m.ndim == 1:
+                    t.mean_values = m
+                else:
+                    t.mean_image = m
+    return t
 
 
 def make_native_feed(
@@ -119,6 +148,8 @@ def build(args) -> tuple:
 
         train_ds = dataset_from_layer(train_layer, solver_dir)
         test_ds = dataset_from_layer(test_layer, solver_dir)
+    train_native = train_ds is not None
+    test_native = test_ds is not None
     if train_ds is None:
         train_ds, mean = cifar10_dataset(
             data_dir, train=True, synthetic_n=args.synthetic_n
@@ -158,30 +189,29 @@ def build(args) -> tuple:
         test_ds = multihost.host_shard(test_ds)
         feed_train_bs, feed_test_bs = train_bs // nproc, test_bs // nproc
 
-    def transformer_for(layer, train: bool) -> Transformer:
-        t = Transformer.from_message(
-            layer.transform_param if layer else None, train=train
-        )
-        tp = layer.transform_param if layer else None
-        if tp is not None and tp.get("mean_file") is not None:
-            # a real .binaryproto wins; otherwise recompute from data
-            # (Caffe's compute_image_mean output, regenerated)
-            mf = resolve_model_path(str(tp.get("mean_file")), solver_dir)
-            if os.path.exists(mf):
-                from ..proto.caffemodel import load_binaryproto_mean
+    # missing .binaryproto -> the precomputed full-dataset mean
+    train_tf = make_transformer(train_layer, True, solver_dir, lambda: mean)
+    test_tf = make_transformer(test_layer, False, solver_dir, lambda: mean)
 
-                t.mean_image = load_binaryproto_mean(mf)
-            else:
-                t.mean_image = mean  # precomputed full-dataset mean
-        return t
+    # without a crop the net sees the source's own resolution: CIFAR's
+    # 32x32 for the built-in loaders, whatever the LMDB/ImageData/HDF5
+    # source holds otherwise
+    def native_hw(ds):
+        sample = ds.collect_partition(0)["data"]
+        return tuple(sample.shape[1:3])
 
-    train_tf = transformer_for(train_layer, True)
-    test_tf = transformer_for(test_layer, False)
-
-    crop = train_tf.crop_size or 32
-    shapes = {"data": (train_bs, crop, crop, 3), "label": (train_bs,)}
-    test_crop = test_tf.crop_size or 32
-    test_shapes = {"data": (test_bs, test_crop, test_crop, 3), "label": (test_bs,)}
+    th, tw = (
+        (train_tf.crop_size, train_tf.crop_size)
+        if train_tf.crop_size
+        else (native_hw(train_ds) if train_native else (32, 32))
+    )
+    eh, ew = (
+        (test_tf.crop_size, test_tf.crop_size)
+        if test_tf.crop_size
+        else (native_hw(test_ds) if test_native else (32, 32))
+    )
+    shapes = {"data": (train_bs, th, tw, 3), "label": (train_bs,)}
+    test_shapes = {"data": (test_bs, eh, ew, 3), "label": (test_bs,)}
 
     kw = dict(
         test_input_shapes=test_shapes,

@@ -82,14 +82,26 @@ def build(args):
 
     data_dir = None if args.synthetic else args.data_dir
     classes = args.synthetic_classes
-    train_ds = imagenet_dataset(
-        data_dir, train=True, synthetic_n=args.synthetic_n,
-        synthetic_classes=classes,
-    )
-    test_ds = imagenet_dataset(
-        data_dir, train=False, synthetic_n=args.synthetic_n,
-        synthetic_classes=classes,
-    )
+    # Caffe-native sources (LMDB/ImageData/HDF5) named in the prototxt
+    # win when present on disk (same policy as CifarApp)
+    train_ds = test_ds = None
+    if not args.synthetic:
+        from ..data.caffe_layers import dataset_from_layer
+
+        train_ds = dataset_from_layer(train_layer, solver_dir)
+        test_ds = dataset_from_layer(test_layer, solver_dir)
+    train_native = train_ds is not None
+    test_native = test_ds is not None
+    if train_ds is None:
+        train_ds = imagenet_dataset(
+            data_dir, train=True, synthetic_n=args.synthetic_n,
+            synthetic_classes=classes,
+        )
+    if test_ds is None:
+        test_ds = imagenet_dataset(
+            data_dir, train=False, synthetic_n=args.synthetic_n,
+            synthetic_classes=classes,
+        )
 
     # multi-host: per-host data shards + local feed rows, global solver
     # batch (see cifar_app.build)
@@ -107,17 +119,36 @@ def build(args):
         test_ds = multihost.host_shard(test_ds)
         feed_train_bs, feed_test_bs = train_bs // nproc, test_bs // nproc
 
-    train_tf = Transformer.from_message(
-        train_layer.transform_param if train_layer else None, train=True
+    # missing mean .binaryproto -> the Caffe zoo's BGR channel means
+    from .cifar_app import make_transformer
+
+    from ..data.imagenet import BGR_MEAN
+
+    train_tf = make_transformer(
+        train_layer, True, solver_dir, lambda: BGR_MEAN
     )
-    test_tf = Transformer.from_message(
-        test_layer.transform_param if test_layer else None, train=False
+    test_tf = make_transformer(
+        test_layer, False, solver_dir, lambda: BGR_MEAN
     )
 
-    crop = train_tf.crop_size or 224
-    test_crop = test_tf.crop_size or crop
-    shapes = {"data": (train_bs, crop, crop, 3), "label": (train_bs,)}
-    test_shapes = {"data": (test_bs, test_crop, test_crop, 3), "label": (test_bs,)}
+    # without a crop the net sees the source's own resolution (same
+    # policy as CifarApp); built-in loaders resize to 256 -> default 224
+    def native_hw(ds):
+        sample = ds.collect_partition(0)["data"]
+        return tuple(sample.shape[1:3])
+
+    ch, cw = (
+        (train_tf.crop_size, train_tf.crop_size)
+        if train_tf.crop_size
+        else (native_hw(train_ds) if train_native else (224, 224))
+    )
+    eh, ew = (
+        (test_tf.crop_size, test_tf.crop_size)
+        if test_tf.crop_size
+        else (native_hw(test_ds) if test_native else (ch, cw))
+    )
+    shapes = {"data": (train_bs, ch, cw, 3), "label": (train_bs,)}
+    test_shapes = {"data": (test_bs, eh, ew, 3), "label": (test_bs,)}
 
     kw = dict(
         test_input_shapes=test_shapes,

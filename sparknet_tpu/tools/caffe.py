@@ -1,0 +1,143 @@
+"""``caffe`` CLI twin — the reference-era binary's subcommand surface.
+
+    python -m sparknet_tpu.tools.caffe train --solver=s.prototxt \
+        [--weights=m.caffemodel] [--snapshot=state.solverstate.npz] [...]
+    python -m sparknet_tpu.tools.caffe test  --model=net.prototxt \
+        --weights=m.caffemodel [--iterations=50]
+    python -m sparknet_tpu.tools.caffe time  --solver=s.prototxt [...]
+
+``train`` routes to CifarApp's generic loop (any prototxt works — the
+app name is historical); ``time`` to tools/time_net; ``test`` builds
+the TEST-phase net and reports averaged metrics.  Both ``--flag=value``
+and ``--flag value`` spellings are accepted, like the original binary.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+
+def _split_eq(argv: List[str]) -> List[str]:
+    out: List[str] = []
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            k, _, v = a.partition("=")
+            out.extend([k, v])
+        else:
+            out.append(a)
+    return out
+
+
+def _train(argv: List[str]):
+    from ..apps import cifar_app
+
+    args = _split_eq(argv)
+    # caffe spells resume as --snapshot=<state>; our apps as --restore
+    args = ["--restore" if a == "--snapshot" else a for a in args]
+    return cifar_app.main(args)
+
+
+def _time(argv: List[str]):
+    from . import time_net
+
+    return time_net.main(_split_eq(argv))
+
+
+def _test(argv: List[str]):
+    import argparse
+
+    import jax
+    import numpy as np
+
+    from ..data.caffe_layers import dataset_from_layer
+    from ..nets.xlanet import XLANet
+    from ..proto import caffe_pb
+
+    ap = argparse.ArgumentParser(prog="caffe test")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--weights", default=None)
+    ap.add_argument("--iterations", type=int, default=50)
+    args = ap.parse_args(_split_eq(argv))
+
+    import os
+
+    net_param = caffe_pb.load_net(args.model)
+    data_layer = next(
+        (
+            l
+            for l in net_param.layers_for_phase("TEST")
+            if l.type in ("Data", "ImageData", "HDF5Data")
+        ),
+        None,
+    )
+    model_dir = os.path.dirname(os.path.abspath(args.model))
+    ds = dataset_from_layer(data_layer, model_dir)
+    if ds is None:
+        raise SystemExit("caffe test: the net's TEST data source was not found")
+    from ..apps.cifar_app import _batch_size, _dataset_mean, make_transformer
+
+    bs = _batch_size(data_layer, 32)
+    # honour transform_param (mean/scale/crop) exactly like training
+    tf = make_transformer(
+        data_layer, False, model_dir, lambda: _dataset_mean(ds)
+    )
+    sample_hw = ds.collect_partition(0)["data"].shape[1:3]
+    hw = (tf.crop_size, tf.crop_size) if tf.crop_size else tuple(sample_hw)
+    test_net = XLANet(
+        net_param, "TEST", {"data": (bs, *hw, 3), "label": (bs,)}
+    )
+    params, state = test_net.init(jax.random.PRNGKey(0))
+    if args.weights:
+        import jax.numpy as jnp
+
+        from ..proto import caffemodel as cm
+
+        imported, st = cm.import_caffemodel(args.weights, test_net)
+        params = jax.tree_util.tree_map(
+            jnp.asarray, cm.merge_into(jax.device_get(params), imported)
+        )
+        if st:
+            state = jax.tree_util.tree_map(
+                jnp.asarray, cm.merge_into(jax.device_get(state), st)
+            )
+    def transform(batch, rng):
+        return {
+            "data": np.asarray(tf(batch["data"], rng), np.float32),
+            "label": np.asarray(batch["label"], np.int32),
+        }
+
+    feed = ds.batches(bs, shuffle=False, epochs=1, transform=transform)
+    acc: dict = {}
+    n = 0
+    for batch in feed:
+        if n >= args.iterations:
+            break
+        import jax.numpy as jnp
+
+        blobs, _ = test_net.apply(
+            params, state,
+            {"data": jnp.asarray(batch["data"]),
+             "label": jnp.asarray(batch["label"])},
+            train=False, rng=None,
+        )
+        _, metrics = test_net.loss_and_metrics(blobs)
+        for k, v in metrics.items():
+            acc[k] = acc.get(k, 0.0) + float(v)
+        n += 1
+    for k, v in acc.items():
+        print(f"{k} = {v / max(n, 1):.4f}")
+    return {k: v / max(n, 1) for k, v in acc.items()}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("train", "test", "time"):
+        print("usage: caffe train|test|time [--flag=value ...]")
+        raise SystemExit(2)
+    cmd, rest = argv[0], argv[1:]
+    return {"train": _train, "test": _test, "time": _time}[cmd](rest)
+
+
+if __name__ == "__main__":
+    main()

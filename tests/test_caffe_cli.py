@@ -1,0 +1,77 @@
+"""``caffe`` CLI twin: train/test/time over a toolchain-built LMDB."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.caffe_layers import encode_datum
+from sparknet_tpu.data.lmdb_io import write_lmdb
+from sparknet_tpu.tools import caffe as caffe_cli
+
+
+@pytest.fixture()
+def workspace(tmp_path):
+    rng = np.random.default_rng(0)
+    for db, n in (("train_lmdb", 64), ("test_lmdb", 32)):
+        imgs = rng.integers(0, 256, (n, 16, 16, 3), dtype=np.uint8)
+        labels = rng.integers(0, 4, n)
+        os.makedirs(tmp_path / db)
+        write_lmdb(
+            str(tmp_path / db),
+            [
+                (f"{i:08d}".encode(), encode_datum(imgs[i], int(labels[i])))
+                for i in range(n)
+            ],
+        )
+    net = tmp_path / "net.prototxt"
+    net.write_text(f"""
+name: "cli"
+layer {{ name: "d" type: "Data" top: "data" top: "label"
+        include {{ phase: TRAIN }}
+        data_param {{ source: "{tmp_path}/train_lmdb" batch_size: 8 }} }}
+layer {{ name: "d" type: "Data" top: "data" top: "label"
+        include {{ phase: TEST }}
+        data_param {{ source: "{tmp_path}/test_lmdb" batch_size: 8 }} }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param {{ num_output: 4
+          weight_filler {{ type: "gaussian" std: 0.01 }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label" top: "loss" }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "ip1" bottom: "label" top: "accuracy"
+        include {{ phase: TEST }} }}
+""")
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{net}"
+base_lr: 0.001
+momentum: 0.9
+lr_policy: "fixed"
+display: 2
+max_iter: 4
+test_interval: 4
+test_iter: 2
+""")
+    return tmp_path
+
+
+def test_caffe_train_and_time(workspace):
+    result = caffe_cli.main(
+        ["train", f"--solver={workspace}/solver.prototxt"]
+    )
+    assert "accuracy" in result
+    out = caffe_cli.main(
+        ["time", f"--solver={workspace}/solver.prototxt", "--iters", "3"]
+    )
+    assert out["train_step_ms"] > 0
+
+
+def test_caffe_test_subcommand(workspace):
+    metrics = caffe_cli.main(
+        ["test", f"--model={workspace}/net.prototxt", "--iterations=3"]
+    )
+    assert "accuracy" in metrics and 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_caffe_usage_error():
+    with pytest.raises(SystemExit):
+        caffe_cli.main(["bogus"])
