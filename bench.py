@@ -179,13 +179,26 @@ def bench_bert(platform: str) -> dict:
     m = solver.step(feed(), 2)
     float(m["loss"])
 
-    flops_batch = _step_flops(solver, one)
-    if flops_batch is None:
-        # 6 * params * tokens (fwd+bwd), attention excluded — lower bound
-        n_params = sum(
-            x.size for x in jax.tree_util.tree_leaves(solver.params)
-        )
-        flops_batch = 6.0 * n_params * bs * seq
+    # Analytic model (6*matmul-params/token convention, honest about
+    # what actually multiplies): embedding tables are lookups (0 FLOPs);
+    # the tied vocab matmul runs only on the n_pred masked positions;
+    # attention score/value matmuls add 12*L*H*S per token (train).
+    # Used UNCONDITIONALLY for BERT — XLA cost analysis is blind to
+    # FLOPs inside Pallas kernels, so mixing it in would let the two
+    # attention paths report under different accounting (CA also counts
+    # the reference path's S^2 softmax elementwise work, flattering it).
+    emb = solver.params["embeddings"]
+    table = sum(
+        emb[k].size for k in ("word", "position", "token_type")
+    )
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(solver.params))
+    per_token = (
+        6.0 * (n_params - table)
+        + 12.0 * cfg.num_layers * cfg.hidden_size * seq
+    )
+    flops_batch = per_token * bs * seq + (
+        6.0 * cfg.hidden_size * cfg.vocab_size * n_pred * bs
+    )
 
     iters = int(os.environ.get("BENCH_ITERS", 10 if platform != "cpu" else 2))
     t0 = time.perf_counter()

@@ -57,6 +57,23 @@ def _batch_size(layer, default: int) -> int:
     return default
 
 
+def source_data_shape(ds, crop_size, native, default_hw):
+    """(h, w, c) the net will see from this data source: a crop fixes
+    H,W; channels always come from the source itself, so grayscale
+    LMDB/ImageData/HDF5 nets (e.g. MNIST LeNet) get 1-channel inputs.
+    Native sources answer via ``ShardedDataset.sample_shape()`` — a
+    cheap single-record probe (LMDB: one datum; ImageData: image
+    header; HDF5: metadata), not a partition decode.  Shared by both
+    image apps and the ``caffe`` CLI twin."""
+    if native:
+        h, w, c = ds.sample_shape()
+    else:
+        (h, w), c = default_hw, 3
+    if crop_size:
+        h = w = crop_size
+    return int(h), int(w), int(c)
+
+
 def make_transformer(layer, train: bool, solver_dir: str, fallback_mean=None):
     """transform_param -> Transformer, resolving ``mean_file``: a real
     .binaryproto wins; otherwise ``fallback_mean()`` supplies the mean
@@ -193,25 +210,14 @@ def build(args) -> tuple:
     train_tf = make_transformer(train_layer, True, solver_dir, lambda: mean)
     test_tf = make_transformer(test_layer, False, solver_dir, lambda: mean)
 
-    # without a crop the net sees the source's own resolution: CIFAR's
-    # 32x32 for the built-in loaders, whatever the LMDB/ImageData/HDF5
-    # source holds otherwise
-    def native_hw(ds):
-        sample = ds.collect_partition(0)["data"]
-        return tuple(sample.shape[1:3])
-
-    th, tw = (
-        (train_tf.crop_size, train_tf.crop_size)
-        if train_tf.crop_size
-        else (native_hw(train_ds) if train_native else (32, 32))
+    th, tw, tc = source_data_shape(
+        train_ds, train_tf.crop_size, train_native, (32, 32)
     )
-    eh, ew = (
-        (test_tf.crop_size, test_tf.crop_size)
-        if test_tf.crop_size
-        else (native_hw(test_ds) if test_native else (32, 32))
+    eh, ew, ec = source_data_shape(
+        test_ds, test_tf.crop_size, test_native, (32, 32)
     )
-    shapes = {"data": (train_bs, th, tw, 3), "label": (train_bs,)}
-    test_shapes = {"data": (test_bs, eh, ew, 3), "label": (test_bs,)}
+    shapes = {"data": (train_bs, th, tw, tc), "label": (train_bs,)}
+    test_shapes = {"data": (test_bs, eh, ew, ec), "label": (test_bs,)}
 
     kw = dict(
         test_input_shapes=test_shapes,

@@ -120,7 +120,7 @@ def build(args):
         feed_train_bs, feed_test_bs = train_bs // nproc, test_bs // nproc
 
     # missing mean .binaryproto -> the Caffe zoo's BGR channel means
-    from .cifar_app import make_transformer
+    from .cifar_app import make_transformer, source_data_shape
 
     from ..data.imagenet import BGR_MEAN
 
@@ -131,24 +131,16 @@ def build(args):
         test_layer, False, solver_dir, lambda: BGR_MEAN
     )
 
-    # without a crop the net sees the source's own resolution (same
-    # policy as CifarApp); built-in loaders resize to 256 -> default 224
-    def native_hw(ds):
-        sample = ds.collect_partition(0)["data"]
-        return tuple(sample.shape[1:3])
-
-    ch, cw = (
-        (train_tf.crop_size, train_tf.crop_size)
-        if train_tf.crop_size
-        else (native_hw(train_ds) if train_native else (224, 224))
+    # same source-shape policy as CifarApp (crop wins H/W, channels
+    # from the source); built-in loaders resize to 256 -> default 224
+    ch, cw, cc = source_data_shape(
+        train_ds, train_tf.crop_size, train_native, (224, 224)
     )
-    eh, ew = (
-        (test_tf.crop_size, test_tf.crop_size)
-        if test_tf.crop_size
-        else (native_hw(test_ds) if test_native else (ch, cw))
+    eh, ew, ec = source_data_shape(
+        test_ds, test_tf.crop_size, test_native, (ch, cw)
     )
-    shapes = {"data": (train_bs, ch, cw, 3), "label": (train_bs,)}
-    test_shapes = {"data": (test_bs, eh, ew, 3), "label": (test_bs,)}
+    shapes = {"data": (train_bs, ch, cw, cc), "label": (train_bs,)}
+    test_shapes = {"data": (test_bs, eh, ew, ec), "label": (test_bs,)}
 
     kw = dict(
         test_input_shapes=test_shapes,

@@ -127,7 +127,17 @@ def lmdb_dataset(source: str, num_partitions: int = 8) -> ShardedDataset:
 
         return load
 
-    return ShardedDataset([make(c) for c in chunks])
+    def peek_shape():
+        # decode exactly one datum — shape probes must not pull a
+        # whole partition through the decoder
+        for _, val in LMDBReader(source).leaf_items(pages[0]):
+            img, _ = decode_datum(val)
+            return img.shape
+        raise ValueError(f"empty LMDB leaf page in {source!r}")
+
+    return ShardedDataset(
+        [make(c) for c in chunks], sample_shape_fn=peek_shape
+    )
 
 
 def read_image_list(source: str, root_folder: str = "") -> List[Tuple[str, int]]:
@@ -174,7 +184,19 @@ def image_data_dataset(
         entries[i : i + files_per_part]
         for i in range(0, len(entries), files_per_part)
     ]
-    return ShardedDataset([make(c) for c in chunks])
+
+    def peek_shape():
+        if new_height and new_width:
+            return (new_height, new_width, 3)
+        from PIL import Image
+
+        with Image.open(entries[0][0]) as im:  # header only, no decode
+            w, h = im.size
+        return (h, w, 3)  # loader convert("RGB")s everything
+
+    return ShardedDataset(
+        [make(c) for c in chunks], sample_shape_fn=peek_shape
+    )
 
 
 def hdf5_dataset(source: str) -> ShardedDataset:
@@ -196,7 +218,16 @@ def hdf5_dataset(source: str) -> ShardedDataset:
 
         return load
 
-    return ShardedDataset([make(p) for p in files])
+    def peek_shape():
+        import h5py
+
+        with h5py.File(files[0], "r") as f:  # metadata only
+            shp = f["data"].shape
+        if len(shp) == 4:  # stored NCHW, loader transposes to NHWC
+            return (shp[2], shp[3], shp[1])
+        return tuple(shp[1:])
+
+    return ShardedDataset([make(p) for p in files], sample_shape_fn=peek_shape)
 
 
 def dataset_from_layer(layer, base_dir: str = ".") -> Optional[ShardedDataset]:

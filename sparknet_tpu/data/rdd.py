@@ -30,8 +30,15 @@ import numpy as np
 class ShardedDataset:
     """A list of lazily-evaluated partitions with RDD-style combinators."""
 
-    def __init__(self, partition_fns: Sequence[Callable[[], Any]]):
+    def __init__(
+        self,
+        partition_fns: Sequence[Callable[[], Any]],
+        sample_shape_fn: Optional[Callable[[], Sequence[int]]] = None,
+    ):
         self._fns = list(partition_fns)
+        # cheap per-source probe for one record's shape (LMDB: decode a
+        # single datum; ImageData: image header; HDF5: dataset metadata)
+        self._sample_shape_fn = sample_shape_fn
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -47,7 +54,26 @@ class ShardedDataset:
                 return lambda: {k: v[lo:hi] for k, v in arrays.items()}
             return lambda: arrays[lo:hi]
 
-        return cls([make(i) for i in range(num_partitions) if i * per < n])
+        shape_fn = (
+            (lambda: arrays["data"].shape[1:])
+            if isinstance(arrays, dict) and "data" in arrays
+            else None
+        )
+        return cls(
+            [make(i) for i in range(num_partitions) if i * per < n],
+            sample_shape_fn=shape_fn,
+        )
+
+    def sample_shape(self) -> tuple:
+        """Shape of one "data" record (e.g. (h, w, c)).  Uses the
+        source's cheap probe when the constructor provided one; only
+        falls back to decoding partition 0 (whole-thunk lazy, and NOT
+        cached — the fallback re-decodes) when it didn't."""
+        if self._sample_shape_fn is not None:
+            return tuple(int(x) for x in self._sample_shape_fn())
+        return tuple(
+            int(x) for x in self.collect_partition(0)["data"].shape[1:]
+        )
 
     # -- combinators (lazy; compose lineage) ------------------------------
     def map_partitions(self, fn: Callable[[Any], Any]) -> "ShardedDataset":
@@ -79,7 +105,8 @@ class ShardedDataset:
     def shard(self, host_id: int, num_hosts: int) -> "ShardedDataset":
         """Deterministic host shard: partition i goes to host i % num_hosts."""
         return ShardedDataset(
-            [f for i, f in enumerate(self._fns) if i % num_hosts == host_id]
+            [f for i, f in enumerate(self._fns) if i % num_hosts == host_id],
+            sample_shape_fn=self._sample_shape_fn,  # same records per row
         )
 
     # -- iteration ---------------------------------------------------------

@@ -125,9 +125,18 @@ def _dropout_keep(seed, rate, qi, kb, blk_q, blk_k):
     s1 = seed ^ (pl.program_id(0) * 65536 + pl.program_id(1))
     s2 = qi * 65536 + kb
     pltpu.prng_seed(s1, s2)
-    bits = pltpu.prng_random_bits((blk_q, blk_k))  # uint32
-    threshold = min(int(rate * 4294967296.0), 4294967295)
-    return bits >= jnp.uint32(threshold)
+    # prng_random_bits is declared int32 (uniform over the full 32-bit
+    # range), and Mosaic lowers the comparison SIGNED — an unsigned
+    # threshold silently gives the wrong keep rate on hardware (measured
+    # keep 0.4 at rate 0.1).  Compare in the signed domain with the
+    # threshold shifted by -2^31: P(bits >= t) = 1 - rate exactly.
+    # (Interpret mode stubs the bits to 0, which is not random at all:
+    # 0 >= t keeps everything for rate <= 0.5 and drops everything
+    # above; dropout can only be validated on real hardware.)
+    bits = pltpu.prng_random_bits((blk_q, blk_k))
+    threshold = int(rate * 4294967296.0) - 2147483648
+    threshold = min(max(threshold, -2147483648), 2147483647)
+    return bits.astype(jnp.int32) >= jnp.int32(threshold)
 
 
 def _fwd_kernel(
@@ -661,16 +670,18 @@ def attention(
 
     ``force`` = "flash" | "reference" overrides (tests, benchmarks).
     Attention-probability dropout exists on both paths; the flash
-    kernels implement it via the in-kernel TPU PRNG.  Because the TPU
-    PRNG lowering has no CPU/interpret fallback and is young on this
-    toolchain, *auto* dispatch keeps active dropout on the reference
-    path unless ``SPARKNET_FLASH_DROPOUT=1`` (or ``force="flash"``)
-    opts in — an explicit, documented policy rather than a silent skip.
+    kernels implement it via the in-kernel TPU PRNG, burned in on real
+    v5e hardware (keep-rate and fwd/bwd mask-consistency measured), so
+    dropout rides the flash path by default on TPU.
+    ``SPARKNET_FLASH_DROPOUT=0`` opts back out to the reference path.
+    Note the interpret-mode PRNG is stubbed to constant bits=0 (keeps
+    all for rate <= 0.5, drops all above): dropout statistics are only
+    meaningful on hardware.
     """
     import os
 
     dropping = dropout_rate > 0.0 and dropout_rng is not None
-    flash_dropout_ok = bool(int(os.environ.get("SPARKNET_FLASH_DROPOUT", "0")))
+    flash_dropout_ok = bool(int(os.environ.get("SPARKNET_FLASH_DROPOUT", "1")))
     use_flash = force == "flash" or (
         force is None
         and jax.default_backend() == "tpu"

@@ -75,17 +75,48 @@ def _test(argv: List[str]):
     ds = dataset_from_layer(data_layer, model_dir)
     if ds is None:
         raise SystemExit("caffe test: the net's TEST data source was not found")
-    from ..apps.cifar_app import _batch_size, _dataset_mean, make_transformer
+    from ..apps.cifar_app import (
+        _batch_size,
+        _dataset_mean,
+        make_transformer,
+        source_data_shape,
+    )
 
     bs = _batch_size(data_layer, 32)
+
+    # A regenerated mean must match what training subtracted: training
+    # computes it over the TRAIN split, so evaluation does too (falling
+    # back to the TEST source only when the net has no TRAIN data layer)
+    def regenerated_mean():
+        train_layer = next(
+            (
+                l
+                for l in net_param.layers_for_phase("TRAIN")
+                if l.type in ("Data", "ImageData", "HDF5Data")
+            ),
+            None,
+        )
+        mean_ds = dataset_from_layer(train_layer, model_dir)
+        src = mean_ds if mean_ds is not None else ds
+        m = _dataset_mean(src)
+        # TRAIN and TEST sources at different native resolutions (e.g.
+        # 256x256 train LMDB, pre-cropped test images): a per-pixel
+        # train mean cannot be subtracted from test batches — collapse
+        # to the per-channel mean, the standard Caffe fallback when
+        # mean dims differ from data dims
+        if (
+            src is not ds
+            and m.ndim == 3
+            and tuple(m.shape[:2]) != tuple(ds.sample_shape()[:2])
+        ):
+            m = m.mean((0, 1))
+        return m
+
     # honour transform_param (mean/scale/crop) exactly like training
-    tf = make_transformer(
-        data_layer, False, model_dir, lambda: _dataset_mean(ds)
-    )
-    sample_hw = ds.collect_partition(0)["data"].shape[1:3]
-    hw = (tf.crop_size, tf.crop_size) if tf.crop_size else tuple(sample_hw)
+    tf = make_transformer(data_layer, False, model_dir, regenerated_mean)
+    h, w, c = source_data_shape(ds, tf.crop_size, True, None)
     test_net = XLANet(
-        net_param, "TEST", {"data": (bs, *hw, 3), "label": (bs,)}
+        net_param, "TEST", {"data": (bs, h, w, c), "label": (bs,)}
     )
     params, state = test_net.init(jax.random.PRNGKey(0))
     if args.weights:

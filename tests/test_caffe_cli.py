@@ -75,3 +75,66 @@ def test_caffe_test_subcommand(workspace):
 def test_caffe_usage_error():
     with pytest.raises(SystemExit):
         caffe_cli.main(["bogus"])
+
+
+@pytest.fixture()
+def gray_workspace(tmp_path):
+    """MNIST-LeNet-shaped setup: grayscale 1-channel LMDB."""
+    rng = np.random.default_rng(3)
+    for db, n in (("train_lmdb", 32), ("test_lmdb", 16)):
+        imgs = rng.integers(0, 256, (n, 12, 12, 1), dtype=np.uint8)
+        labels = rng.integers(0, 3, n)
+        os.makedirs(tmp_path / db)
+        write_lmdb(
+            str(tmp_path / db),
+            [
+                (f"{i:08d}".encode(), encode_datum(imgs[i], int(labels[i])))
+                for i in range(n)
+            ],
+        )
+    net = tmp_path / "net.prototxt"
+    net.write_text(f"""
+name: "gray"
+layer {{ name: "d" type: "Data" top: "data" top: "label"
+        include {{ phase: TRAIN }}
+        transform_param {{ crop_size: 8 }}
+        data_param {{ source: "{tmp_path}/train_lmdb" batch_size: 8 }} }}
+layer {{ name: "d" type: "Data" top: "data" top: "label"
+        include {{ phase: TEST }}
+        transform_param {{ crop_size: 8 }}
+        data_param {{ source: "{tmp_path}/test_lmdb" batch_size: 8 }} }}
+layer {{ name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+        convolution_param {{ num_output: 4 kernel_size: 3
+          weight_filler {{ type: "gaussian" std: 0.1 }} }} }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+        inner_product_param {{ num_output: 3
+          weight_filler {{ type: "gaussian" std: 0.01 }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label" top: "loss" }}
+layer {{ name: "accuracy" type: "Accuracy" bottom: "ip1" bottom: "label" top: "accuracy"
+        include {{ phase: TEST }} }}
+""")
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f"""
+net: "{net}"
+base_lr: 0.001
+momentum: 0.9
+lr_policy: "fixed"
+max_iter: 2
+test_interval: 2
+test_iter: 1
+""")
+    return tmp_path
+
+
+def test_caffe_grayscale_lmdb(gray_workspace):
+    """Non-RGB sources must flow through with their true channel count
+    (regression: input shapes once hardcoded 3 channels, breaking any
+    grayscale net even with a crop)."""
+    result = caffe_cli.main(
+        ["train", f"--solver={gray_workspace}/solver.prototxt"]
+    )
+    assert "accuracy" in result
+    metrics = caffe_cli.main(
+        ["test", f"--model={gray_workspace}/net.prototxt", "--iterations=2"]
+    )
+    assert 0.0 <= metrics["accuracy"] <= 1.0
