@@ -55,6 +55,7 @@ LOSS_LAYER_TYPES = {
     "SigmoidCrossEntropyLoss",
     "EuclideanLoss",
     "HingeLoss",
+    "ContrastiveLoss",
 }
 
 
@@ -119,6 +120,15 @@ def fill(filler: Filler, rng: jax.Array, shape: Shape, fan_in: int, fan_out: int
         std = math.sqrt(2.0 / fan_in)
         return std * jax.random.normal(rng, shape, jnp.float32)
     raise NotImplementedError(f"filler type {t!r}")
+
+
+def nchw_view(shape) -> List[int]:
+    """The NCHW view of an NHWC 4D shape; non-4D shapes already carry
+    NCHW-order axes (see the Reshape policy below)."""
+    if len(shape) == 4:
+        n, h, w, c = shape
+        return [n, c, h, w]
+    return list(shape)
 
 
 def _conv_geom(lp: LayerParameter):
@@ -940,6 +950,349 @@ class Accuracy:
         return outs, None
 
 
+class PReLU:
+    """Learnable leaky slope, per channel (Caffe NCHW channel -> our
+    trailing axis) or shared (``channel_shared``); filler default 0.25."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        p = lp.sub("prelu_param")
+        shared = bool(p.get("channel_shared", False)) if p else False
+        c = 1 if shared else int(in_shapes[0][-1])
+        fm = p.get("filler") if p else None
+        filler = (
+            Filler.from_message(fm)
+            if fm is not None
+            else Filler(type="constant", value=0.25)
+        )
+        return {"slope": fill(filler, rng, (c,), c, c)}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        a = params["slope"].astype(x.dtype)
+        return [jnp.where(x > 0, x, a * x)], None
+
+
+class Threshold(_Elementwise):
+    @classmethod
+    def apply(cls, lp, params, state, inputs, ctx):
+        p = lp.sub("threshold_param")
+        t = float(p.get("threshold", 0.0)) if p else 0.0
+        x = inputs[0]
+        return [(x > t).astype(x.dtype)], None
+
+
+class Tile:
+    @staticmethod
+    def _geom(lp, ndim):
+        p = lp.sub("tile_param")
+        axis = caffe_axis(int(p.get("axis", 1)) if p else 1, ndim)
+        tiles = int(p.get("tiles")) if p else 1
+        return axis, tiles
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        s = list(in_shapes[0])
+        axis, tiles = Tile._geom(lp, len(s))
+        s[axis] *= tiles
+        return [tuple(s)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        axis, tiles = Tile._geom(lp, x.ndim)
+        reps = [1] * x.ndim
+        reps[axis] = tiles
+        return [jnp.tile(x, reps)], None
+
+
+class MVN:
+    """Mean-variance normalization per sample: over H,W per channel, or
+    over C,H,W when ``across_channels``."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [in_shapes[0]]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        p = lp.sub("mvn_param")
+        across = bool(p.get("across_channels", False)) if p else False
+        norm_var = bool(p.get("normalize_variance", True)) if p else True
+        eps = float(p.get("eps", 1e-9)) if p else 1e-9
+        x = inputs[0].astype(jnp.float32)
+        axes = tuple(range(1, x.ndim)) if across else tuple(range(1, x.ndim - 1))
+        mu = jnp.mean(x, axes, keepdims=True)
+        y = x - mu
+        if norm_var:
+            # Caffe divides by sqrt(E[(x-mu)^2]) + eps (eps OUTSIDE)
+            y = y / (jnp.sqrt(jnp.mean(jnp.square(y), axes, keepdims=True)) + eps)
+        return [y.astype(inputs[0].dtype)], None
+
+
+class ArgMax:
+    """Per-sample top-k indices (float blob, like Caffe); ``axis`` keeps
+    dims and disallows out_max_val pairs, axis-less flattens the sample."""
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.sub("argmax_param")
+        top_k = int(p.get("top_k", 1)) if p else 1
+        out_max = bool(p.get("out_max_val", False)) if p else False
+        axis = p.get("axis") if p else None
+        return top_k, out_max, (None if axis is None else int(axis))
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        top_k, out_max, axis = ArgMax._geom(lp)
+        s = in_shapes[0]
+        if axis is not None:
+            out = list(s)
+            out[caffe_axis(axis, len(s))] = top_k
+            return [tuple(out)]
+        return [(s[0], 2 if out_max else 1, top_k)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        top_k, out_max, axis = ArgMax._geom(lp)
+        x = inputs[0].astype(jnp.float32)
+        if axis is not None:
+            ax = caffe_axis(axis, x.ndim)
+            xm = jnp.moveaxis(x, ax, -1)
+            vals, idx = lax.top_k(xm, top_k)
+            # with an axis, Caffe emits the top-k VALUES when
+            # out_max_val is set (indices otherwise) — never pairs
+            y = vals if out_max else idx.astype(jnp.float32)
+            return [jnp.moveaxis(y, -1, ax)], None
+        flat = x.reshape(x.shape[0], -1)
+        vals, idx = lax.top_k(flat, top_k)
+        idx = idx.astype(jnp.float32)[:, None, :]
+        if out_max:
+            return [jnp.concatenate([idx, vals[:, None, :]], axis=1)], None
+        return [idx], None
+
+
+class Embed:
+    """Lookup table: integer indices -> (…, num_output) rows."""
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.sub("embed_param")
+        return (
+            int(p.get("num_output")),
+            int(p.get("input_dim")),
+            # caffe.proto EmbedParameter: bias_term [default = true]
+            bool(p.get("bias_term", True)),
+        )
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        cout, _, _ = Embed._geom(lp)
+        return [tuple(in_shapes[0]) + (cout,)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        cout, vocab, bias = Embed._geom(lp)
+        p = lp.sub("embed_param")
+        wf = Filler.from_message(p.get("weight_filler"))
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": fill(wf, k1, (vocab, cout), vocab, cout)}
+        if bias:
+            bf = Filler.from_message(p.get("bias_filler"))
+            params["bias"] = fill(bf, k2, (cout,), vocab, cout)
+        return params
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        idx = inputs[0].astype(jnp.int32)
+        y = params["weight"][idx]
+        if "bias" in params:
+            y = y + params["bias"]
+        return [y.astype(ctx.compute_dtype)], None
+
+
+class Reduction:
+    """Reduce every axis from ``axis`` to the end of the NCHW view
+    (Caffe flattens the tail); non-4D outputs keep NCHW-order axes,
+    matching the Reshape policy above."""
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.sub("reduction_param")
+        op = str(p.get("operation", "SUM")) if p else "SUM"
+        axis = int(p.get("axis", 0)) if p else 0
+        coeff = float(p.get("coeff", 1.0)) if p else 1.0
+        return op, axis, coeff
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        _, axis, _ = Reduction._geom(lp)
+        nchw = nchw_view(in_shapes[0])
+        axis = axis % len(nchw) if axis else 0
+        return [tuple(nchw[:axis])]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        op, axis, coeff = Reduction._geom(lp)
+        x = inputs[0].astype(jnp.float32)
+        if x.ndim == 4:
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        axis = axis % x.ndim if axis else 0
+        axes = tuple(range(axis, x.ndim))
+        if op == "SUM":
+            y = jnp.sum(x, axes)
+        elif op == "ASUM":
+            y = jnp.sum(jnp.abs(x), axes)
+        elif op == "SUMSQ":
+            y = jnp.sum(jnp.square(x), axes)
+        elif op == "MEAN":
+            y = jnp.mean(x, axes)
+        else:
+            raise NotImplementedError(f"reduction op {op}")
+        return [(coeff * y).astype(inputs[0].dtype)], None
+
+
+class Crop:
+    """Crop bottom[0] to bottom[1]'s size from ``axis`` (NCHW view)
+    onward at the given offsets, like the FCN skip-connection crops."""
+
+    @staticmethod
+    def _geom(lp, ndim):
+        p = lp.sub("crop_param")
+        axis = int(p.get("axis", 2)) if p else 2
+        offsets = [int(o) for o in p.get_all("offset")] if p else []
+        return axis % ndim, offsets
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        a = nchw_view(in_shapes[0])
+        b = nchw_view(in_shapes[1])
+        axis, _ = Crop._geom(lp, len(a))
+        out = a[:axis] + b[axis:]
+        if len(out) == 4:
+            n, c, h, w = out
+            return [(n, h, w, c)]
+        return [tuple(out)]
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0]
+        ref_nchw = nchw_view(inputs[1].shape)
+        x_nchw4 = x.ndim == 4
+        if x_nchw4:
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        axis, offsets = Crop._geom(lp, x.ndim)
+        starts = [0] * x.ndim
+        sizes = list(x.shape)
+        for i in range(axis, x.ndim):
+            j = i - axis
+            off = offsets[j] if j < len(offsets) else (offsets[0] if offsets else 0)
+            starts[i] = off
+            sizes[i] = ref_nchw[i]
+        y = lax.slice(
+            x, starts, [s + z for s, z in zip(starts, sizes)]
+        )
+        if x_nchw4:
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return [y], None
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+
+class Silence:
+    """Consumes its bottoms, produces nothing (suppresses unused-blob
+    plumbing in prototxts)."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return []
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        return [], None
+
+
+class HingeLoss:
+    """One-vs-all hinge over (N, C) scores: t=+1 at the label, -1
+    elsewhere; L1 or squared (L2) norm, averaged over N."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        x = inputs[0].astype(jnp.float32)
+        labels = inputs[1].astype(jnp.int32).reshape(-1)
+        t = 2.0 * jax.nn.one_hot(labels, x.shape[-1]) - 1.0
+        m = jnp.maximum(0.0, 1.0 - t * x)
+        p = lp.sub("hinge_loss_param")
+        norm = str(p.get("norm", "L1")) if p else "L1"
+        if norm == "L2":
+            m = jnp.square(m)
+        return [jnp.sum(m) / x.shape[0]], None
+
+
+class ContrastiveLoss:
+    """Siamese pairs: y=1 similar pulls d^2, y=0 dissimilar pushes to
+    ``margin``; legacy_version uses Caffe's original margin-d^2 form."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        a = inputs[0].astype(jnp.float32).reshape(inputs[0].shape[0], -1)
+        b = inputs[1].astype(jnp.float32).reshape(inputs[1].shape[0], -1)
+        y = inputs[2].astype(jnp.float32).reshape(-1)
+        p = lp.sub("contrastive_loss_param")
+        margin = float(p.get("margin", 1.0)) if p else 1.0
+        legacy = bool(p.get("legacy_version", False)) if p else False
+        d2 = jnp.sum(jnp.square(a - b), -1)
+        if legacy:
+            dissim = jnp.maximum(margin - d2, 0.0)
+        else:
+            dissim = jnp.square(jnp.maximum(margin - jnp.sqrt(d2 + 1e-12), 0.0))
+        loss = jnp.sum(y * d2 + (1.0 - y) * dissim) / (2.0 * a.shape[0])
+        return [loss], None
+
+
 LAYER_IMPLS = {
     "Convolution": Convolution,
     "Deconvolution": Deconvolution,
@@ -970,4 +1323,15 @@ LAYER_IMPLS = {
     "SigmoidCrossEntropyLoss": SigmoidCrossEntropyLoss,
     "EuclideanLoss": EuclideanLoss,
     "Accuracy": Accuracy,
+    "PReLU": PReLU,
+    "Threshold": Threshold,
+    "Tile": Tile,
+    "MVN": MVN,
+    "ArgMax": ArgMax,
+    "Embed": Embed,
+    "Reduction": Reduction,
+    "Crop": Crop,
+    "Silence": Silence,
+    "HingeLoss": HingeLoss,
+    "ContrastiveLoss": ContrastiveLoss,
 }

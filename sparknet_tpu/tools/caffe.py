@@ -161,13 +161,34 @@ def _test(argv: List[str]):
     return {k: v / max(n, 1) for k, v in acc.items()}
 
 
+def _device_query(argv: List[str]):
+    """Twin of ``caffe device_query``: one line per visible accelerator."""
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print(f"device_query: backend init failed: {type(e).__name__}: {e}")
+        return []
+    for d in devices:
+        kind = getattr(d, "device_kind", d.platform)
+        print(f"Device id: {d.id}  platform: {d.platform}  kind: {kind}")
+    return devices
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] not in ("train", "test", "time"):
-        print("usage: caffe train|test|time [--flag=value ...]")
+    cmds = {
+        "train": _train,
+        "test": _test,
+        "time": _time,
+        "device_query": _device_query,
+    }
+    if not argv or argv[0] not in cmds:
+        print("usage: caffe train|test|time|device_query [--flag=value ...]")
         raise SystemExit(2)
     cmd, rest = argv[0], argv[1:]
-    return {"train": _train, "test": _test, "time": _time}[cmd](rest)
+    return cmds[cmd](rest)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,133 @@
+"""extract_features — dump intermediate blob activations to an LMDB.
+
+Twin of Caffe's ``tools/extract_features.cpp``: run a net's data layer
+for N batches and write the named blob's per-sample features as float
+Datums (channels = feature length), the format downstream Caffe-era
+pipelines (SVM training, retrieval indexes) consume.
+
+    python -m sparknet_tpu.tools.extract_features \
+        --model net.prototxt [--weights w.caffemodel|.npz] \
+        --blob ip1 --out feats_lmdb [--iterations 10] [--phase TEST]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def extract(
+    model: str,
+    blob: str,
+    out: str,
+    weights: Optional[str] = None,
+    iterations: int = 10,
+    phase: str = "TEST",
+) -> int:
+    from ..apps.cifar_app import _batch_size, make_transformer, source_data_shape
+    from ..data.caffe_layers import dataset_from_layer, encode_datum
+    from ..data.lmdb_io import write_lmdb
+    from ..nets.xlanet import XLANet
+    from ..proto import caffe_pb
+
+    net_param = caffe_pb.load_net(model)
+    model_dir = os.path.dirname(os.path.abspath(model))
+    data_layer = next(
+        (
+            l
+            for l in net_param.layers_for_phase(phase)
+            if l.type in ("Data", "ImageData", "HDF5Data")
+        ),
+        None,
+    )
+    ds = dataset_from_layer(data_layer, model_dir)
+    if ds is None:
+        raise SystemExit(
+            f"extract_features: no on-disk data source in phase {phase}"
+        )
+    bs = _batch_size(data_layer, 32)
+    tf = make_transformer(data_layer, False, model_dir, None)
+    h, w, c = source_data_shape(ds, tf.crop_size, True, None)
+    net = XLANet(net_param, phase, {"data": (bs, h, w, c), "label": (bs,)})
+    if blob not in net.blob_shapes:
+        raise SystemExit(
+            f"extract_features: blob {blob!r} not in net "
+            f"(have: {sorted(net.blob_shapes)})"
+        )
+    params, state = net.init(jax.random.PRNGKey(0))
+    if weights:
+        from ..proto import caffemodel as cm
+
+        if weights.endswith(".npz"):
+            from ..nets.weights import load_npz
+
+            params = cm.merge_into(jax.device_get(params), load_npz(weights))
+            params = jax.tree_util.tree_map(jnp.asarray, params)
+        else:
+            imported, st = cm.import_caffemodel(weights, net)
+            params = jax.tree_util.tree_map(
+                jnp.asarray, cm.merge_into(jax.device_get(params), imported)
+            )
+            if st:
+                state = jax.tree_util.tree_map(
+                    jnp.asarray, cm.merge_into(jax.device_get(state), st)
+                )
+
+    @jax.jit
+    def fwd(batch):
+        blobs, _ = net.apply(params, state, batch, train=False, rng=None)
+        return blobs[blob]
+
+    def transform(batch, rng):
+        return {
+            "data": np.asarray(tf(batch["data"], rng), np.float32),
+            "label": np.asarray(batch["label"], np.int32),
+        }
+
+    feed = ds.batches(bs, shuffle=False, seed=0, transform=transform)
+    items = []
+    for it in range(iterations):
+        batch = next(feed)
+        feats = np.asarray(
+            fwd({k: jnp.asarray(v) for k, v in batch.items()}), np.float32
+        )
+        flat = feats.reshape(len(feats), -1)
+        for j, f in enumerate(flat):
+            # Caffe stores features as channels=D, h=1, w=1 Datums;
+            # encode_datum takes (H, W, C)
+            items.append(
+                (
+                    f"{it * bs + j:010d}".encode(),
+                    encode_datum(f.reshape(1, 1, -1), int(batch["label"][j])),
+                )
+            )
+    os.makedirs(out, exist_ok=True)
+    write_lmdb(out, items)
+    return len(items)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="extract_features")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--weights", default=None)
+    ap.add_argument("--blob", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--phase", default="TEST", choices=("TRAIN", "TEST"))
+    args = ap.parse_args(argv)
+    n = extract(
+        args.model, args.blob, args.out,
+        weights=args.weights, iterations=args.iterations, phase=args.phase,
+    )
+    print(f"extracted {n} {args.blob} features to {args.out}")
+    return n
+
+
+if __name__ == "__main__":
+    main()

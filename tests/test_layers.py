@@ -254,3 +254,173 @@ def test_bf16_compute_grad_path():
     assert flat and all(np.all(np.isfinite(np.asarray(g, np.float32))) for g in flat)
     # params stay f32 master copies; grads match param dtype
     assert all(g.dtype == jnp.float32 for g in flat)
+
+
+def test_prelu_vs_torch():
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(2, 5, 7, 7)).astype(np.float32)
+    slope = rng.uniform(0.1, 0.4, size=(5,)).astype(np.float32)
+    lp = lp_from('name: "pr" type: "PReLU"')
+    (y,), _ = L.PReLU.apply(lp, {"slope": jnp.asarray(slope)}, None, [nhwc(x)], CTX)
+    ref = F.prelu(torch.from_numpy(x), torch.from_numpy(slope)).numpy()
+    np.testing.assert_allclose(to_nchw(y), ref, rtol=1e-6)
+    # channel_shared init -> single slope at Caffe's 0.25 default
+    lp2 = lp_from(
+        'name: "pr" type: "PReLU" prelu_param { channel_shared: true }'
+    )
+    p = L.PReLU.init(lp2, jax.random.PRNGKey(0), [(2, 7, 7, 5)])
+    assert p["slope"].shape == (1,) and float(p["slope"][0]) == 0.25
+
+
+def test_threshold_tile_mvn():
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+    lp = lp_from('name: "t" type: "Threshold" threshold_param { threshold: 0.2 }')
+    (y,), _ = L.Threshold.apply(lp, {}, None, [nhwc(x)], CTX)
+    np.testing.assert_array_equal(to_nchw(y), (x > 0.2).astype(np.float32))
+
+    # Tile along the channel axis (Caffe axis 1 -> NHWC trailing)
+    lp = lp_from('name: "ti" type: "Tile" tile_param { axis: 1 tiles: 3 }')
+    xin = nhwc(x)
+    assert L.Tile.infer(lp, [xin.shape]) == [(2, 4, 4, 9)]
+    (y,), _ = L.Tile.apply(lp, {}, None, [xin], CTX)
+    np.testing.assert_allclose(
+        to_nchw(y), np.tile(x, (1, 3, 1, 1)), rtol=1e-6
+    )
+
+    # MVN per channel: zero mean, unit variance over H,W
+    lp = lp_from('name: "m" type: "MVN"')
+    (y,), _ = L.MVN.apply(lp, {}, None, [nhwc(x)], CTX)
+    yn = to_nchw(y)
+    np.testing.assert_allclose(yn.mean((2, 3)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(yn.std((2, 3)), 1.0, atol=1e-3)
+    # across_channels without variance: mean over C,H,W removed only
+    lp = lp_from(
+        'name: "m" type: "MVN" mvn_param { across_channels: true '
+        "normalize_variance: false }"
+    )
+    (y,), _ = L.MVN.apply(lp, {}, None, [nhwc(x)], CTX)
+    np.testing.assert_allclose(to_nchw(y).mean((1, 2, 3)), 0.0, atol=1e-5)
+
+
+def test_argmax_embed_reduction():
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(3, 7)).astype(np.float32)
+    lp = lp_from(
+        'name: "a" type: "ArgMax" argmax_param { top_k: 2 out_max_val: true }'
+    )
+    (y,), _ = L.ArgMax.apply(lp, {}, None, [jnp.asarray(x)], CTX)
+    assert y.shape == (3, 2, 2)
+    ref_v, ref_i = torch.topk(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(np.asarray(y)[:, 0], ref_i.numpy(), rtol=0)
+    np.testing.assert_allclose(np.asarray(y)[:, 1], ref_v.numpy(), rtol=1e-6)
+
+    lp = lp_from(
+        'name: "e" type: "Embed" embed_param { num_output: 6 input_dim: 11 '
+        'bias_term: true weight_filler { type: "gaussian" std: 1.0 } }'
+    )
+    params = L.Embed.init(lp, jax.random.PRNGKey(1), [(4,)])
+    ids = jnp.asarray([0, 3, 10, 3], jnp.int32)
+    (y,), _ = L.Embed.apply(lp, params, None, [ids], CTX)
+    assert y.shape == (4, 6)
+    np.testing.assert_allclose(
+        np.asarray(y),
+        np.asarray(params["weight"])[np.asarray(ids)] + np.asarray(params["bias"]),
+        rtol=1e-6,
+    )
+
+    x4 = rng.normal(size=(2, 3, 4, 5)).astype(np.float32)  # NCHW view
+    lp = lp_from(
+        'name: "r" type: "Reduction" reduction_param { operation: SUMSQ '
+        "axis: 2 coeff: 0.5 }"
+    )
+    xin = nhwc(x4)
+    assert L.Reduction.infer(lp, [xin.shape]) == [(2, 3)]
+    (y,), _ = L.Reduction.apply(lp, {}, None, [xin], CTX)
+    np.testing.assert_allclose(
+        np.asarray(y), 0.5 * np.square(x4).sum((2, 3)), rtol=1e-5
+    )
+
+
+def test_crop_matches_fcn_semantics():
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(2, 3, 10, 12)).astype(np.float32)
+    ref = np.zeros((2, 3, 6, 7), np.float32)
+    lp = lp_from(
+        'name: "c" type: "Crop" crop_param { axis: 2 offset: 2 offset: 3 }'
+    )
+    shapes = L.Crop.infer(lp, [nhwc(x).shape, nhwc(ref).shape])
+    assert shapes == [(2, 6, 7, 3)]
+    (y,), _ = L.Crop.apply(lp, {}, None, [nhwc(x), nhwc(ref)], CTX)
+    np.testing.assert_allclose(
+        to_nchw(y), x[:, :, 2:8, 3:10], rtol=1e-6
+    )
+    # single offset broadcast to all cropped axes
+    lp = lp_from('name: "c" type: "Crop" crop_param { axis: 2 offset: 1 }')
+    (y,), _ = L.Crop.apply(lp, {}, None, [nhwc(x), nhwc(ref)], CTX)
+    np.testing.assert_allclose(to_nchw(y), x[:, :, 1:7, 1:8], rtol=1e-6)
+
+
+def test_hinge_and_contrastive_losses_vs_torch():
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(6, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(6,))
+    lp = lp_from('name: "h" type: "HingeLoss"')
+    (l1,), _ = L.HingeLoss.apply(
+        lp, {}, None, [jnp.asarray(x), jnp.asarray(labels)], CTX
+    )
+    t = -np.ones_like(x)
+    t[np.arange(6), labels] = 1.0
+    ref = np.maximum(0, 1 - t * x).sum() / 6
+    np.testing.assert_allclose(float(l1), ref, rtol=1e-5)
+    lp = lp_from(
+        'name: "h" type: "HingeLoss" hinge_loss_param { norm: L2 }'
+    )
+    (l2,), _ = L.HingeLoss.apply(
+        lp, {}, None, [jnp.asarray(x), jnp.asarray(labels)], CTX
+    )
+    np.testing.assert_allclose(
+        float(l2), np.square(np.maximum(0, 1 - t * x)).sum() / 6, rtol=1e-5
+    )
+
+    a = rng.normal(size=(6, 4)).astype(np.float32)
+    b = rng.normal(size=(6, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(6,)).astype(np.float32)
+    lp = lp_from(
+        'name: "cl" type: "ContrastiveLoss" '
+        "contrastive_loss_param { margin: 1.5 }"
+    )
+    (lc,), _ = L.ContrastiveLoss.apply(
+        lp, {}, None, [jnp.asarray(a), jnp.asarray(b), jnp.asarray(y)], CTX
+    )
+    d = np.linalg.norm(a - b, axis=1)
+    ref = (y * d**2 + (1 - y) * np.maximum(1.5 - d, 0) ** 2).sum() / (2 * 6)
+    np.testing.assert_allclose(float(lc), ref, rtol=1e-5)
+
+
+def test_silence_produces_nothing():
+    lp = lp_from('name: "s" type: "Silence"')
+    assert L.Silence.infer(lp, [(2, 3)]) == []
+    outs, _ = L.Silence.apply(lp, {}, None, [jnp.zeros((2, 3))], CTX)
+    assert outs == []
+
+
+def test_argmax_axis_out_max_val_and_embed_bias_default():
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(3, 7)).astype(np.float32)
+    # axis + out_max_val -> Caffe emits the top-k VALUES along the axis
+    lp = lp_from(
+        'name: "a" type: "ArgMax" argmax_param { axis: 1 top_k: 2 '
+        "out_max_val: true }"
+    )
+    (y,), _ = L.ArgMax.apply(lp, {}, None, [jnp.asarray(x)], CTX)
+    ref_v, _ = torch.topk(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(np.asarray(y), ref_v.numpy(), rtol=1e-6)
+
+    # caffe.proto EmbedParameter: bias_term defaults TRUE
+    lp = lp_from(
+        'name: "e" type: "Embed" embed_param { num_output: 4 input_dim: 9 '
+        'weight_filler { type: "gaussian" std: 1.0 } }'
+    )
+    params = L.Embed.init(lp, jax.random.PRNGKey(2), [(3,)])
+    assert "bias" in params and params["bias"].shape == (4,)

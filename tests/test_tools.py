@@ -160,3 +160,109 @@ def cifar_app_args(solver_path, data_dir):
         parallel="none", tau=10, restore=None, auto_resume=False,
         weights=None, profile_dir=None, seed=0,
     )
+
+
+def test_convert_mnist_to_lenet_training(tmp_path):
+    """idx files -> convert_mnist_data -> LMDB -> LeNet via the caffe
+    CLI: the full published MNIST workflow on synthetic digits."""
+    import struct
+
+    from sparknet_tpu.tools.convert_mnist_data import convert as mnist_convert
+
+    rng = np.random.default_rng(0)
+
+    def write_idx(n, name_img, name_lab):
+        imgs = rng.integers(0, 256, (n, 28, 28), dtype=np.uint8)
+        labs = rng.integers(0, 10, n).astype(np.uint8)
+        with open(tmp_path / name_img, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / name_lab, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labs.tobytes())
+
+    write_idx(64, "train-images", "train-labels")
+    write_idx(128, "t10k-images", "t10k-labels")  # >= TEST batch_size 100
+    n = mnist_convert(
+        str(tmp_path / "train-images"),
+        str(tmp_path / "train-labels"),
+        str(tmp_path / "mnist_train_lmdb"),
+    )
+    assert n == 64
+    mnist_convert(
+        str(tmp_path / "t10k-images"),
+        str(tmp_path / "t10k-labels"),
+        str(tmp_path / "mnist_test_lmdb"),
+    )
+
+    # stage the zoo LeNet files next to the LMDBs (data_param sources
+    # are relative, exactly like the published example)
+    zoo = os.path.join(
+        os.path.dirname(__file__), "..", "sparknet_tpu", "models", "prototxt"
+    )
+    for f in ("lenet_train_test.prototxt", "lenet_solver.prototxt"):
+        with open(os.path.join(zoo, f)) as src:
+            (tmp_path / f).write_text(src.read())
+
+    from sparknet_tpu.tools import caffe as caffe_cli
+
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        result = caffe_cli.main(
+            [
+                "train",
+                f"--solver={tmp_path}/lenet_solver.prototxt",
+                "--max-iter", "2",
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    assert "accuracy" in result
+
+
+def test_extract_features(tmp_path):
+    """extract_features dumps a named blob to a float-Datum LMDB that
+    decodes back to the right shapes and labels."""
+    from sparknet_tpu.data.caffe_layers import encode_datum, lmdb_dataset
+    from sparknet_tpu.data.lmdb_io import write_lmdb
+    from sparknet_tpu.tools.extract_features import extract
+
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, (48, 12, 12, 3), dtype=np.uint8)
+    labels = rng.integers(0, 5, 48)
+    os.makedirs(tmp_path / "db")
+    write_lmdb(
+        str(tmp_path / "db"),
+        [
+            (f"{i:08d}".encode(), encode_datum(imgs[i], int(labels[i])))
+            for i in range(48)
+        ],
+    )
+    net = tmp_path / "net.prototxt"
+    net.write_text(f"""
+name: "feat"
+layer {{ name: "d" type: "Data" top: "data" top: "label"
+        include {{ phase: TEST }}
+        data_param {{ source: "{tmp_path}/db" batch_size: 8 }} }}
+layer {{ name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param {{ num_output: 7
+          weight_filler {{ type: "gaussian" std: 0.1 }} }} }}
+layer {{ name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }}
+""")
+    n = extract(
+        str(net), "ip1", str(tmp_path / "feats_lmdb"), iterations=3
+    )
+    assert n == 24
+    feats = lmdb_dataset(str(tmp_path / "feats_lmdb"), num_partitions=1)
+    part = feats.collect_partition(0)
+    assert part["data"].shape == (24, 1, 1, 7)
+    assert set(np.unique(part["label"])) <= set(range(5))
+
+
+def test_caffe_device_query(capsys):
+    from sparknet_tpu.tools import caffe as caffe_cli
+
+    devices = caffe_cli.main(["device_query"])
+    outp = capsys.readouterr().out
+    assert len(devices) >= 1 and "Device id:" in outp

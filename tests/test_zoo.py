@@ -30,6 +30,8 @@ def _count(params):
         # ResNet-50 25.557M
         ("bvlc_googlenet_train_val.prototxt", 13_378_280),
         ("resnet50_train_val.prototxt", 25_557_032),
+        # VGG-16 configuration D published total
+        ("vgg16_train_val.prototxt", 138_357_544),
     ],
 )
 def test_zoo_shapes_and_param_counts(proto, total):
@@ -87,3 +89,28 @@ def test_googlenet_trains():
         assert np.isfinite(m[k])
     # initial CE should be near ln(1000)
     assert abs(m["loss3/loss"] - np.log(1000.0)) < 1.5
+
+
+def test_lenet_param_count_and_train():
+    """The classic MNIST LeNet: published total 431,080 params
+    (20·1·5·5+20 + 50·20·5·5+50 + 500·800+500 + 10·500+10); grayscale
+    28x28 inputs flow through with 1 channel."""
+    npm = caffe_pb.load_net(os.path.join(ZOO, "lenet_train_test.prototxt"))
+    net = XLANet(npm, "TRAIN", {"data": (4, 28, 28, 1), "label": (4,)})
+    params, _ = net.init(jax.random.PRNGKey(0))
+    assert _count(params) == 431_080
+    sp = caffe_pb.load_solver(os.path.join(ZOO, "lenet_solver.prototxt"))
+    sp.max_iter = 2
+    solver = Solver(sp, {"data": (4, 28, 28, 1), "label": (4,)}, solver_dir=ZOO)
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=(4, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, 4), jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    m = solver.step(feed(), 2)
+    assert np.isfinite(float(m["loss"]))
