@@ -3,7 +3,8 @@
 Behavioral twin of the reference's ``ImageNetApp`` (SURVEY.md §2;
 ``spark-submit`` there, ``python -m sparknet_tpu.apps.imagenet_app``
 here): picks an architecture from the zoo (AlexNet / GoogLeNet /
-ResNet-50 — the BASELINE.json ImageNetApp configs), loads ImageNet
+ResNet-50 — the BASELINE.json ImageNetApp configs — plus
+VGG-16), loads ImageNet
 (folder / tar-shard / npz layouts, or synthetic), applies the net's
 ``transform_param`` (256→crop, mirror, mean), and trains — single chip
 or across the mesh (``--parallel sync`` gradient all-reduce, or
@@ -35,6 +36,7 @@ ARCH_SOLVERS = {
     "alexnet": "bvlc_alexnet_solver.prototxt",
     "googlenet": "bvlc_googlenet_quick_solver.prototxt",
     "resnet50": "resnet50_solver.prototxt",
+    "vgg16": "vgg16_solver.prototxt",
 }
 
 

@@ -170,6 +170,17 @@ def data_layers(w: W, crop: int, train_bs: int, test_bs: int) -> None:
         w.close()
 
 
+def dropout(w: W, name: str, blob: str, ratio: float) -> str:
+    w.open("layer")
+    w.line(f'name: "{name}"')
+    w.line('type: "Dropout"')
+    w.line(f'bottom: "{blob}"')
+    w.line(f'top: "{blob}"')
+    w.line(f"dropout_param {{ dropout_ratio: {ratio} }}")
+    w.close()
+    return blob
+
+
 def softmax_head(w: W, prefix: str, bottom: str, loss_weight: float = 1.0) -> None:
     w.open("layer")
     w.line(f'name: "{prefix}/loss"')
@@ -228,13 +239,7 @@ def aux_head(w: W, prefix: str, bottom: str) -> None:
     relu(w, f"{prefix}/relu_conv", c)
     f1 = fc(w, f"{prefix}/fc", c, 1024, bias_value=0.2)
     relu(w, f"{prefix}/relu_fc", f1)
-    w.open("layer")
-    w.line(f'name: "{prefix}/drop_fc"')
-    w.line('type: "Dropout"')
-    w.line(f'bottom: "{f1}"')
-    w.line(f'top: "{f1}"')
-    w.line("dropout_param { dropout_ratio: 0.7 }")
-    w.close()
+    dropout(w, f"{prefix}/drop_fc", f1, 0.7)
     cls = fc(w, f"{prefix}/classifier", f1, 1000, std=0.0009765625)
     softmax_head(w, prefix, cls, loss_weight=0.3)
 
@@ -285,13 +290,7 @@ def googlenet() -> str:
     b = inception(w, "inception_5a", b, 256, 160, 320, 32, 128, 128)
     b = inception(w, "inception_5b", b, 384, 192, 384, 48, 128, 128)
     b = pool(w, "pool5/7x7_s1", b, "AVE", 7, 1)
-    w.open("layer")
-    w.line('name: "pool5/drop_7x7_s1"')
-    w.line('type: "Dropout"')
-    w.line(f'bottom: "{b}"')
-    w.line(f'top: "{b}"')
-    w.line("dropout_param { dropout_ratio: 0.4 }")
-    w.close()
+    dropout(w, "pool5/drop_7x7_s1", b, 0.4)
     cls = fc(w, "loss3/classifier", b, 1000, filler="xavier")
     softmax_head(w, "loss3", cls, loss_weight=1.0)
     return w.text()
@@ -391,6 +390,49 @@ def resnet50() -> str:
     return w.text()
 
 
+def vgg16() -> str:
+    """VGG-16 (configuration D): 13 3x3 convs in 5 blocks + 3 FCs,
+    published total 138,357,544 params."""
+    w = W()
+    w.line('name: "VGG_ILSVRC_16"')
+    data_layers(w, crop=224, train_bs=64, test_bs=50)
+    blocks = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+    blob = "data"
+    for bi, (num, reps) in enumerate(blocks, start=1):
+        for ri in range(1, reps + 1):
+            name = f"conv{bi}_{ri}"
+            blob = conv(w, name, blob, num, 3, pad=1, filler="gaussian",
+                        std=0.01, bias_value=0.0)
+            relu(w, f"relu{bi}_{ri}", blob)
+        blob = pool(w, f"pool{bi}", blob, "MAX", 2, 2)
+    for fi, num in ((6, 4096), (7, 4096)):
+        blob = fc(w, f"fc{fi}", blob, num, filler="gaussian", std=0.005)
+        relu(w, f"relu{fi}", blob)
+        dropout(w, f"drop{fi}", blob, 0.5)
+    blob = fc(w, "fc8", blob, 1000, filler="gaussian", std=0.01)
+    softmax_head(w, "loss", blob)
+    return w.text()
+
+
+def vgg16_solver() -> str:
+    return """# VGG-16 schedule (published: step/10, high momentum+decay).
+net: "vgg16_train_val.prototxt"
+test_iter: 1000
+test_interval: 10000
+display: 20
+base_lr: 0.01
+lr_policy: "step"
+gamma: 0.1
+stepsize: 100000
+max_iter: 370000
+momentum: 0.9
+weight_decay: 0.0005
+snapshot: 10000
+snapshot_prefix: "vgg16"
+solver_mode: GPU
+"""
+
+
 def googlenet_solver() -> str:
     return """# bvlc_googlenet quick_solver-style schedule (poly decay).
 net: "bvlc_googlenet_train_val.prototxt"
@@ -437,6 +479,8 @@ GENERATED = {
     "bvlc_googlenet_quick_solver.prototxt": googlenet_solver,
     "resnet50_train_val.prototxt": resnet50,
     "resnet50_solver.prototxt": resnet50_solver,
+    "vgg16_train_val.prototxt": vgg16,
+    "vgg16_solver.prototxt": vgg16_solver,
 }
 
 

@@ -643,6 +643,8 @@ class Scale:
 
 
 class Bias:
+    PARAM_ORDER = ("bias",)  # single learned blob
+
     @staticmethod
     def infer(lp, in_shapes):
         return [in_shapes[0]]
@@ -954,6 +956,8 @@ class PReLU:
     """Learnable leaky slope, per channel (Caffe NCHW channel -> our
     trailing axis) or shared (``channel_shared``); filler default 0.25."""
 
+    PARAM_ORDER = ("slope",)  # prototxt param{} spec 0 is the slope blob
+
     @staticmethod
     def infer(lp, in_shapes):
         return [in_shapes[0]]
@@ -1203,11 +1207,20 @@ class Crop:
         if x_nchw4:
             x = jnp.transpose(x, (0, 3, 1, 2))
         axis, offsets = Crop._geom(lp, x.ndim)
+        n_cropped = x.ndim - axis
+        if len(offsets) not in (0, 1, n_cropped):
+            # Caffe's CropLayer CHECKs exactly 1 or n offsets
+            raise ValueError(
+                f"layer {lp.name!r}: crop needs 1 or {n_cropped} offsets, "
+                f"got {len(offsets)}"
+            )
         starts = [0] * x.ndim
         sizes = list(x.shape)
         for i in range(axis, x.ndim):
             j = i - axis
-            off = offsets[j] if j < len(offsets) else (offsets[0] if offsets else 0)
+            off = offsets[j] if len(offsets) == n_cropped else (
+                offsets[0] if offsets else 0
+            )
             starts[i] = off
             sizes[i] = ref_nchw[i]
         y = lax.slice(

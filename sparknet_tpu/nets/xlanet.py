@@ -168,13 +168,18 @@ class XLANet:
 
     def param_specs(self) -> Dict[str, Dict[str, Tuple[float, float]]]:
         """Per-param (lr_mult, decay_mult) from the prototxt ``param {}``
-        entries — consumed by the solver. Caffe order: weight, then bias."""
+        entries — consumed by the solver. Spec index i maps to the
+        layer's i-th blob in ITS declared order (Caffe's blob order):
+        weight-then-bias for most layers, but e.g. PReLU's single blob
+        is the slope — layer impls override via ``PARAM_ORDER``."""
         specs: Dict[str, Dict[str, Tuple[float, float]]] = {}
         for lp in self.layers:
             if lp.type in DATA_LAYER_TYPES:
                 continue
+            impl = LAYER_IMPLS.get(lp.type)
+            order = getattr(impl, "PARAM_ORDER", ("weight", "bias"))
             sp: Dict[str, Tuple[float, float]] = {}
-            for idx, pname in enumerate(("weight", "bias")):
+            for idx, pname in enumerate(order):
                 spec = lp.params[idx] if idx < len(lp.params) else None
                 sp[pname] = (
                     spec.lr_mult if spec else 1.0,

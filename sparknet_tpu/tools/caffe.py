@@ -46,13 +46,12 @@ def _time(argv: List[str]):
 
 def _test(argv: List[str]):
     import argparse
+    import os
 
     import jax
-    import numpy as np
 
-    from ..data.caffe_layers import dataset_from_layer
-    from ..nets.xlanet import XLANet
     from ..proto import caffe_pb
+    from ._common import batch_transform_fn, build_phase_net, load_weights
 
     ap = argparse.ArgumentParser(prog="caffe test")
     ap.add_argument("--model", required=True)
@@ -60,85 +59,18 @@ def _test(argv: List[str]):
     ap.add_argument("--iterations", type=int, default=50)
     args = ap.parse_args(_split_eq(argv))
 
-    import os
-
     net_param = caffe_pb.load_net(args.model)
-    data_layer = next(
-        (
-            l
-            for l in net_param.layers_for_phase("TEST")
-            if l.type in ("Data", "ImageData", "HDF5Data")
-        ),
-        None,
-    )
     model_dir = os.path.dirname(os.path.abspath(args.model))
-    ds = dataset_from_layer(data_layer, model_dir)
-    if ds is None:
+    test_net, ds, tf, bs = build_phase_net(net_param, model_dir, "TEST")
+    if test_net is None:
         raise SystemExit("caffe test: the net's TEST data source was not found")
-    from ..apps.cifar_app import (
-        _batch_size,
-        _dataset_mean,
-        make_transformer,
-        source_data_shape,
-    )
-
-    bs = _batch_size(data_layer, 32)
-
-    # A regenerated mean must match what training subtracted: training
-    # computes it over the TRAIN split, so evaluation does too (falling
-    # back to the TEST source only when the net has no TRAIN data layer)
-    def regenerated_mean():
-        train_layer = next(
-            (
-                l
-                for l in net_param.layers_for_phase("TRAIN")
-                if l.type in ("Data", "ImageData", "HDF5Data")
-            ),
-            None,
-        )
-        mean_ds = dataset_from_layer(train_layer, model_dir)
-        src = mean_ds if mean_ds is not None else ds
-        m = _dataset_mean(src)
-        # TRAIN and TEST sources at different native resolutions (e.g.
-        # 256x256 train LMDB, pre-cropped test images): a per-pixel
-        # train mean cannot be subtracted from test batches — collapse
-        # to the per-channel mean, the standard Caffe fallback when
-        # mean dims differ from data dims
-        if (
-            src is not ds
-            and m.ndim == 3
-            and tuple(m.shape[:2]) != tuple(ds.sample_shape()[:2])
-        ):
-            m = m.mean((0, 1))
-        return m
-
-    # honour transform_param (mean/scale/crop) exactly like training
-    tf = make_transformer(data_layer, False, model_dir, regenerated_mean)
-    h, w, c = source_data_shape(ds, tf.crop_size, True, None)
-    test_net = XLANet(
-        net_param, "TEST", {"data": (bs, h, w, c), "label": (bs,)}
-    )
     params, state = test_net.init(jax.random.PRNGKey(0))
     if args.weights:
-        import jax.numpy as jnp
+        params, state = load_weights(test_net, params, state, args.weights)
 
-        from ..proto import caffemodel as cm
-
-        imported, st = cm.import_caffemodel(args.weights, test_net)
-        params = jax.tree_util.tree_map(
-            jnp.asarray, cm.merge_into(jax.device_get(params), imported)
-        )
-        if st:
-            state = jax.tree_util.tree_map(
-                jnp.asarray, cm.merge_into(jax.device_get(state), st)
-            )
-    def transform(batch, rng):
-        return {
-            "data": np.asarray(tf(batch["data"], rng), np.float32),
-            "label": np.asarray(batch["label"], np.int32),
-        }
-
-    feed = ds.batches(bs, shuffle=False, epochs=1, transform=transform)
+    feed = ds.batches(
+        bs, shuffle=False, epochs=1, transform=batch_transform_fn(tf)
+    )
     acc: dict = {}
     n = 0
     for batch in feed:

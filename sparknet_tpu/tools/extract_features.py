@@ -30,31 +30,18 @@ def extract(
     iterations: int = 10,
     phase: str = "TEST",
 ) -> int:
-    from ..apps.cifar_app import _batch_size, make_transformer, source_data_shape
-    from ..data.caffe_layers import dataset_from_layer, encode_datum
+    from ..data.caffe_layers import encode_datum
     from ..data.lmdb_io import write_lmdb
-    from ..nets.xlanet import XLANet
     from ..proto import caffe_pb
+    from ._common import batch_transform_fn, build_phase_net, load_weights
 
     net_param = caffe_pb.load_net(model)
     model_dir = os.path.dirname(os.path.abspath(model))
-    data_layer = next(
-        (
-            l
-            for l in net_param.layers_for_phase(phase)
-            if l.type in ("Data", "ImageData", "HDF5Data")
-        ),
-        None,
-    )
-    ds = dataset_from_layer(data_layer, model_dir)
-    if ds is None:
+    net, ds, tf, bs = build_phase_net(net_param, model_dir, phase)
+    if net is None:
         raise SystemExit(
             f"extract_features: no on-disk data source in phase {phase}"
         )
-    bs = _batch_size(data_layer, 32)
-    tf = make_transformer(data_layer, False, model_dir, None)
-    h, w, c = source_data_shape(ds, tf.crop_size, True, None)
-    net = XLANet(net_param, phase, {"data": (bs, h, w, c), "label": (bs,)})
     if blob not in net.blob_shapes:
         raise SystemExit(
             f"extract_features: blob {blob!r} not in net "
@@ -62,35 +49,16 @@ def extract(
         )
     params, state = net.init(jax.random.PRNGKey(0))
     if weights:
-        from ..proto import caffemodel as cm
-
-        if weights.endswith(".npz"):
-            from ..nets.weights import load_npz
-
-            params = cm.merge_into(jax.device_get(params), load_npz(weights))
-            params = jax.tree_util.tree_map(jnp.asarray, params)
-        else:
-            imported, st = cm.import_caffemodel(weights, net)
-            params = jax.tree_util.tree_map(
-                jnp.asarray, cm.merge_into(jax.device_get(params), imported)
-            )
-            if st:
-                state = jax.tree_util.tree_map(
-                    jnp.asarray, cm.merge_into(jax.device_get(state), st)
-                )
+        params, state = load_weights(net, params, state, weights)
 
     @jax.jit
     def fwd(batch):
         blobs, _ = net.apply(params, state, batch, train=False, rng=None)
         return blobs[blob]
 
-    def transform(batch, rng):
-        return {
-            "data": np.asarray(tf(batch["data"], rng), np.float32),
-            "label": np.asarray(batch["label"], np.int32),
-        }
-
-    feed = ds.batches(bs, shuffle=False, seed=0, transform=transform)
+    feed = ds.batches(
+        bs, shuffle=False, seed=0, transform=batch_transform_fn(tf)
+    )
     items = []
     for it in range(iterations):
         batch = next(feed)

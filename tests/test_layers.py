@@ -424,3 +424,27 @@ def test_argmax_axis_out_max_val_and_embed_bias_default():
     )
     params = L.Embed.init(lp, jax.random.PRNGKey(2), [(3,)])
     assert "bias" in params and params["bias"].shape == (4,)
+
+
+def test_prelu_param_spec_maps_to_slope():
+    """prototxt param{} spec 0 on a PReLU layer must govern the SLOPE
+    blob (regression: specs were keyed weight/bias for every layer, so
+    PReLU's decay_mult was silently dropped)."""
+    from sparknet_tpu.nets.xlanet import XLANet
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.caffe_solver import mults_for_params
+
+    net = caffe_pb.NetParameter.from_message(parse("""
+name: "p"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 4
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "act" type: "PReLU" bottom: "ip" top: "act"
+        param { lr_mult: 3 decay_mult: 0 } }
+"""))
+    xnet = XLANet(net, "TRAIN", {"data": (2, 8)})
+    params, _ = xnet.init(jax.random.PRNGKey(0))
+    lr, dec = mults_for_params(params, xnet.param_specs())
+    assert lr["act"]["slope"] == 3.0
+    assert dec["act"]["slope"] == 0.0
