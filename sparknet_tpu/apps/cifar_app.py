@@ -313,8 +313,11 @@ def train_loop(
     return last_test
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(description="CIFAR-10 training (CifarApp)")
+def arg_parser() -> argparse.ArgumentParser:
+    """The CifarApp CLI surface; importable (add_help=False-compatible
+    via ``parents=``) so wrapper tools accept the same flags."""
+    ap = argparse.ArgumentParser(description="CIFAR-10 training (CifarApp)",
+                                 add_help=False)
     ap.add_argument(
         "--solver",
         default=os.path.join(
@@ -344,6 +347,12 @@ def main(argv=None):
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(parents=[arg_parser()],
+                                 description="CIFAR-10 training (CifarApp)")
     args = ap.parse_args(argv)
 
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR

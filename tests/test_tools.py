@@ -266,3 +266,21 @@ def test_caffe_device_query(capsys):
     devices = caffe_cli.main(["device_query"])
     outp = capsys.readouterr().out
     assert len(devices) >= 1 and "Device id:" in outp
+
+
+def test_check_determinism_tool():
+    """Two fresh replays of the same schedule must match bitwise (the
+    framework's race-detector analog); a perturbed tree must not."""
+    from sparknet_tpu.tools import check_determinism as cd
+
+    args = [
+        "--synthetic", "--synthetic-n", "256", "--iters", "2",
+        "--batch-size", "8",
+    ]
+    assert cd.main(args) == 0
+
+    a = {"l": {"w": np.zeros((2, 2), np.float32)}}
+    b = {"l": {"w": np.full((2, 2), 1e-7, np.float32)}}
+    bad = cd.compare_trees(a, b)
+    assert bad and bad[0][0] == "l/w"
+    assert cd.compare_trees(a, {"l": {"w": np.zeros((2, 2), np.float32)}}) == []
