@@ -52,7 +52,7 @@ def compare_trees(a, b):
             x, y = np.asarray(pa[name]), np.asarray(pb[name])
             if x.shape != y.shape:
                 bad.append((f"{layer}/{name}", float("inf")))
-            elif x.view(np.uint8).tobytes() != y.view(np.uint8).tobytes():
+            elif x.tobytes() != y.tobytes():
                 diff = float(
                     np.abs(x.astype(np.float64) - y.astype(np.float64)).max()
                 )

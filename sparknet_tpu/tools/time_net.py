@@ -21,16 +21,22 @@ import jax
 import jax.numpy as jnp
 
 
-def time_solver(solver, shapes, iters: int = 50, warmup: int = 3):
-    from ..utils.profiling import compiled_flops, device_peak_flops
-
+def synth_batch(shapes):
+    """The synthetic batch every timing mode shares (same values, so the
+    per-layer table and the whole-step numbers measure identical work)."""
     rng = np.random.default_rng(0)
-    batch = {
+    return {
         "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
         "label": jnp.asarray(
             rng.integers(0, 10, size=shapes["label"]), jnp.int32
         ),
     }
+
+
+def time_solver(solver, shapes, iters: int = 50, warmup: int = 3):
+    from ..utils.profiling import compiled_flops, device_peak_flops
+
+    batch = synth_batch(shapes)
 
     def feed():
         while True:
@@ -82,6 +88,76 @@ def time_solver(solver, shapes, iters: int = 50, warmup: int = 3):
     return out
 
 
+def time_per_layer(net, params, state, batch, iters: int = 10):
+    """Per-layer forward/backward timings, like ``caffe time``'s layer
+    table: each layer's ``apply`` is jitted and timed in isolation on
+    its real input blobs (captured from one full forward), and its
+    backward as the VJP w.r.t. inputs+params at the same point."""
+    from ..nets.layers import DATA_LAYER_TYPES, LAYER_IMPLS, ApplyCtx
+
+    blobs = dict(batch)
+    rows = []
+    for li, lp in enumerate(net.layers):
+        if lp.type in DATA_LAYER_TYPES:
+            continue
+        impl = LAYER_IMPLS[lp.type]
+        # a real per-layer key: Dropout and friends sample masks in
+        # TRAIN mode and need one (rng=None would crash on them)
+        ctx = ApplyCtx(
+            train=True,
+            rng=jax.random.fold_in(jax.random.PRNGKey(0), li),
+            compute_dtype=net.compute_dtype,
+        )
+        inputs = [blobs[b] for b in lp.bottom]
+        p = params.get(lp.name, {})
+        st = state.get(lp.name)
+
+        def fwd(p_, inputs_):
+            outs, _ = impl.apply(lp, p_, st, inputs_, ctx)
+            return outs
+
+        jfwd = jax.jit(fwd)
+        outs = jfwd(p, inputs)
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs = jfwd(p, inputs)
+        jax.block_until_ready(outs)
+        fwd_ms = 1000 * (time.perf_counter() - t0) / iters
+
+        bwd_ms = None
+        # float outputs only: losses/metrics and feature maps; index
+        # outputs (ArgMax) and no-output layers (Silence) have no VJP
+        if outs and all(jnp.issubdtype(o.dtype, jnp.floating) for o in outs):
+            fidx = [
+                i for i, x in enumerate(inputs)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+            ]
+
+            def scalar(p_, finputs):
+                full = list(inputs)
+                for i, x in zip(fidx, finputs):
+                    full[i] = x
+                outs_ = fwd(p_, full)
+                return sum(jnp.sum(o.astype(jnp.float32)) for o in outs_)
+
+            if p or fidx:
+                jbwd = jax.jit(jax.grad(scalar, argnums=(0, 1)))
+                finputs = [inputs[i] for i in fidx]
+                g = jbwd(p, finputs)
+                jax.block_until_ready(g)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    g = jbwd(p, finputs)
+                jax.block_until_ready(g)
+                bwd_ms = 1000 * (time.perf_counter() - t0) / iters
+
+        rows.append((lp.name, lp.type, fwd_ms, bwd_ms))
+        for top, out in zip(lp.top, outs):
+            blobs[top] = out
+    return rows
+
+
 def main(argv=None):
     from ..proto import caffe_pb
     from ..solver.trainer import Solver
@@ -93,6 +169,9 @@ def main(argv=None):
                     help="input H=W (defaults to the net's data shape)")
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--per-layer", action="store_true",
+                    help="also print per-layer forward/backward ms "
+                         "(caffe time's layer table)")
     args = ap.parse_args(argv)
 
     sp = caffe_pb.load_solver(args.solver)
@@ -122,6 +201,21 @@ def main(argv=None):
     out = time_solver(solver, shapes, iters=args.iters)
     for k, v in out.items():
         print(f"{k}: {v}")
+    if args.per_layer:
+        batch = synth_batch(shapes)
+        rows = time_per_layer(
+            solver.train_net, solver.params, solver.state, batch,
+            iters=max(3, args.iters // 5),
+        )
+        print(f"{'layer':<28}{'type':<22}{'fwd ms':>10}{'bwd ms':>10}")
+        for name, ltype, fwd_ms, bwd_ms in rows:
+            b = f"{bwd_ms:.3f}" if bwd_ms is not None else "-"
+            print(f"{name:<28}{ltype:<22}{fwd_ms:>10.3f}{b:>10}")
+        out["per_layer"] = [
+            {"layer": n, "type": t, "forward_ms": round(f, 3),
+             "backward_ms": None if b is None else round(b, 3)}
+            for n, t, f, b in rows
+        ]
     return out
 
 

@@ -284,3 +284,23 @@ def test_check_determinism_tool():
     bad = cd.compare_trees(a, b)
     assert bad and bad[0][0] == "l/w"
     assert cd.compare_trees(a, {"l": {"w": np.zeros((2, 2), np.float32)}}) == []
+
+
+def test_time_net_per_layer(tmp_path):
+    from sparknet_tpu.tools import time_net
+
+    out = time_net.main([
+        "--solver",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "sparknet_tpu", "models", "prototxt",
+            "cifar10_quick_solver.prototxt",
+        ),
+        "--batch-size", "4", "--iters", "3", "--per-layer",
+    ])
+    rows = out["per_layer"]
+    by_type = {r["type"] for r in rows}
+    assert {"Convolution", "Pooling", "ReLU", "InnerProduct"} <= by_type
+    assert all(r["forward_ms"] > 0 for r in rows)
+    conv = next(r for r in rows if r["type"] == "Convolution")
+    assert conv["backward_ms"] and conv["backward_ms"] > 0
