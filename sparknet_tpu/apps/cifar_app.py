@@ -264,6 +264,13 @@ def train_loop(
         )
     t0 = time.time()
     last_test: Dict[str, float] = {}
+    # Caffe runs the TEST net once before training (test_initialization,
+    # default true) — skipped on resume, like a restarted Caffe solver
+    # mid-schedule
+    if sp.test_interval and sp.test_initialization and solver.iter == 0:
+        last_test = solver.test(test_feed)
+        for k, v in last_test.items():
+            log(f"    Test net output: {k} = {v:.4f}")
     while solver.iter < sp.max_iter:
         # stop at the nearest of: next test boundary, next snapshot
         # boundary, max_iter — so neither cadence can skip the other's.

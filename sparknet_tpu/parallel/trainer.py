@@ -164,6 +164,10 @@ class ParallelSolver(Solver):
             )
             self.iter += tau
             d = self.sp.display
-            if log_fn and d and (self.iter // d) > (prev // d):
-                log_fn(self.iter, {k: float(v) for k, v in metrics.items()})
+            if log_fn and d:
+                # round metrics are already tau-means; the window then
+                # smooths across rounds (average_loss parity)
+                self._push_loss(metrics)
+                if (self.iter // d) > (prev // d):
+                    log_fn(self.iter, self._smoothed(metrics))
         return metrics

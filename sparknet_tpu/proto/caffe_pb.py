@@ -260,6 +260,10 @@ class SolverParameter:
     solver_mode: str = "GPU"
     solver_type: str = "SGD"
     random_seed: int = -1
+    # Caffe: run the TEST nets once before training starts
+    test_initialization: bool = True
+    # Caffe: display the loss averaged over the last N iterations
+    average_loss: int = 1
     warmup_iter: int = 0  # extension: linear LR warmup (not in Caffe)
     raw: Optional[Message] = None
 
@@ -297,6 +301,8 @@ class SolverParameter:
             solver_mode=str(m.get("solver_mode", "GPU")),
             solver_type=str(m.get("type", m.get("solver_type", "SGD"))),
             random_seed=int(m.get("random_seed", -1)),
+            test_initialization=bool(m.get("test_initialization", True)),
+            average_loss=int(m.get("average_loss", 1)),
             warmup_iter=int(m.get("warmup_iter", 0)),
             raw=m,
         )

@@ -170,13 +170,19 @@ class Solver:
         self.params, self.state = self.train_net.init(init_rng)
         self.opt_state = init_opt_state(solver, self.params)
         self.iter = 0
+        self._loss_window: list = []  # average_loss display smoothing
         self._train_step = jax.jit(
             make_train_step(self.train_net, solver), donate_argnums=(0, 1, 2)
         )
         self._eval_step = jax.jit(make_eval_step(self.test_net))
 
     def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
-        """Run ``n`` iterations (the reference's ``Solver::Step(n)``)."""
+        """Run ``n`` iterations (the reference's ``Solver::Step(n)``).
+
+        Displayed losses honour Caffe's ``average_loss``: the value
+        handed to ``log_fn`` is smoothed over the last N iterations
+        (device arrays are held lazily; the float() sync happens only
+        at display boundaries)."""
         metrics = {}
         for _ in range(n):
             if self.sp.iter_size > 1:
@@ -197,9 +203,30 @@ class Solver:
                 step_rng,
             )
             self.iter += 1
-            if log_fn and self.sp.display and self.iter % self.sp.display == 0:
-                log_fn(self.iter, {k: float(v) for k, v in metrics.items()})
+            if log_fn and self.sp.display:
+                self._push_loss(metrics)
+                if self.iter % self.sp.display == 0:
+                    log_fn(self.iter, self._smoothed(metrics))
         return metrics
+
+    def _push_loss(self, metrics) -> None:
+        """Record this iteration's loss for ``average_loss`` smoothing
+        (device array held lazily; synced only at display time)."""
+        avg_n = max(1, self.sp.average_loss)
+        if avg_n > 1 and "loss" in metrics:
+            self._loss_window.append(metrics["loss"])
+            if len(self._loss_window) > avg_n:
+                self._loss_window.pop(0)
+
+    def _smoothed(self, metrics) -> Dict[str, float]:
+        """Metrics as floats, with ``loss`` averaged over the window."""
+        out = {k: float(v) for k, v in metrics.items()}
+        if self._loss_window:
+            out["loss"] = float(
+                sum(float(x) for x in self._loss_window)
+                / len(self._loss_window)
+            )
+        return out
 
     # -- snapshot / restore (Caffe .solverstate parity) ------------------
     def save(self, path: str) -> None:
@@ -225,6 +252,7 @@ class Solver:
         st = snapshot.load_state(path)
         self.iter = int(st["it"])
         self.rng = jnp.asarray(st["rng"])
+        self._loss_window = []  # a restarted Caffe starts empty
         self.params, self.state, self.opt_state = self._place_restored(
             st["params"], st["state"], st["opt_state"]
         )

@@ -162,3 +162,68 @@ def test_solver_without_net_raises():
 
     with pytest.raises(ValueError, match="no net"):
         Solver(sp_from("base_lr: 0.1 lr_policy: 'fixed'"), {})
+
+
+def test_average_loss_and_test_initialization():
+    """average_loss smooths displayed losses over the window; the
+    parsed test_initialization/average_loss fields carry defaults."""
+    from sparknet_tpu.proto import caffe_pb
+
+    sp = caffe_pb.load_solver(
+        "net: \"x\"\nbase_lr: 0.1\nlr_policy: \"fixed\"\n"
+        "average_loss: 3\ntest_initialization: false\nmax_iter: 6\n"
+        "display: 1\n",
+        is_path=False,
+    )
+    assert sp.average_loss == 3 and sp.test_initialization is False
+    # defaults (Caffe: test_initialization true, average_loss 1)
+    sp2 = caffe_pb.load_solver(
+        "net: \"x\"\nbase_lr: 0.1\nlr_policy: \"fixed\"\n", is_path=False
+    )
+    assert sp2.test_initialization is True and sp2.average_loss == 1
+
+    import numpy as np
+
+    from sparknet_tpu.solver.trainer import Solver
+
+    net_txt = """
+name: "t"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 2
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+    sp.net_param = caffe_pb.load_net(net_txt, is_path=False)
+    sp.net = sp.train_net = None
+    solver = Solver(sp, {"data": (4, 8), "label": (4,)})
+    rng = np.random.default_rng(0)
+
+    def feed():
+        while True:
+            yield {
+                "data": rng.normal(size=(4, 8)).astype(np.float32),
+                "label": rng.integers(0, 2, 4).astype(np.int32),
+            }
+
+    logged = []
+    solver.step(feed(), 6, log_fn=lambda it, m: logged.append((it, m["loss"])))
+    assert len(logged) == 6
+    # the 3rd displayed loss must equal the mean of the first 3 raw
+    # losses — recompute from a replay with average_loss=1
+    sp_raw = caffe_pb.load_solver(
+        "base_lr: 0.1\nlr_policy: \"fixed\"\nmax_iter: 6\ndisplay: 1\n",
+        is_path=False,
+    )
+    sp_raw.net_param = sp.net_param
+    solver2 = Solver(sp_raw, {"data": (4, 8), "label": (4,)})
+    rng = np.random.default_rng(0)
+    raw = []
+    solver2.step(feed(), 6, log_fn=lambda it, m: raw.append(m["loss"]))
+    np.testing.assert_allclose(
+        logged[2][1], np.mean(raw[:3]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        logged[5][1], np.mean(raw[3:6]), rtol=1e-6
+    )
