@@ -264,10 +264,15 @@ def train_loop(
         )
     t0 = time.time()
     last_test: Dict[str, float] = {}
-    # Caffe runs the TEST net once before training (test_initialization,
-    # default true) — skipped on resume, like a restarted Caffe solver
-    # mid-schedule
-    if sp.test_interval and sp.test_initialization and solver.iter == 0:
+    # Caffe's pre-loop gate (Solver::Step):
+    # iter % test_interval == 0 && (iter > 0 || test_initialization) —
+    # a fresh solver tests once before training unless
+    # test_initialization: false; a solver RESUMED exactly on a test
+    # boundary re-runs that boundary's test before continuing.
+    if sp.test_interval and (
+        (solver.iter == 0 and sp.test_initialization)
+        or (solver.iter > 0 and solver.iter % sp.test_interval == 0)
+    ):
         last_test = solver.test(test_feed)
         for k, v in last_test.items():
             log(f"    Test net output: {k} = {v:.4f}")

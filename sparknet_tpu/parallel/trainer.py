@@ -50,6 +50,14 @@ class ParallelSolver(Solver):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
         self.tau = int(tau)
+        if mode != "sync" and self.tau > 1:
+            # local-SGD materialises only per-round tau-means, so the
+            # display window is in ROUNDS: ceil(average_loss / tau)
+            # rounds ≈ the last average_loss iterations
+            from collections import deque
+
+            n_rounds = -(-max(1, solver.average_loss) // self.tau)
+            self._loss_window = deque(maxlen=n_rounds)
         self.dp_axis = dp_axis
         ndp = self.mesh.shape[dp_axis]
         for which, xnet in (("train", self.train_net), ("test", self.test_net)):
@@ -165,8 +173,9 @@ class ParallelSolver(Solver):
             self.iter += tau
             d = self.sp.display
             if log_fn and d:
-                # round metrics are already tau-means; the window then
-                # smooths across rounds (average_loss parity)
+                # round metrics are already tau-means; the window holds
+                # ceil(average_loss/tau) rounds (sized in __init__), so
+                # the display covers ≈ the last average_loss iterations
                 self._push_loss(metrics)
                 if (self.iter // d) > (prev // d):
                     log_fn(self.iter, self._smoothed(metrics))

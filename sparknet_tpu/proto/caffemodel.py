@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 from . import wire
+from ..nets.layers import LAYER_IMPLS
 
 WeightBlobs = Dict[str, List[np.ndarray]]  # layer name -> caffe-layout blobs
 
@@ -150,16 +151,30 @@ def import_caffemodel(path_or_bytes, net) -> Tuple[Dict, Dict]:
                 "mean": lb[0].reshape(-1) * scale,
                 "var": lb[1].reshape(-1) * scale,
             }
-        elif t in ("Scale", "Bias", "PReLU"):
+        elif t == "Scale":
             entry = {"weight": lb[0].reshape(-1)}
             if len(lb) > 1:
                 entry["bias"] = lb[1].reshape(-1)
             params[lp.name] = entry
-        else:  # unknown parametric layer: keep caffe layout as-is
-            entry = {"weight": lb[0]}
-            if len(lb) > 1:
-                entry["bias"] = lb[1]
-            params[lp.name] = entry
+        else:
+            # generic path: blob i maps to the layer's i-th declared
+            # param name (PReLU: slope; Bias: bias; default
+            # weight/bias) — the same PARAM_ORDER contract export uses,
+            # so the two sides can never disagree. Legacy 4-D vector
+            # blobs like (1,1,1,C) flatten to the 1-D param shape.
+            order = getattr(
+                LAYER_IMPLS.get(t), "PARAM_ORDER", ("weight", "bias")
+            )
+            entry = {}
+            for i, name in enumerate(order):
+                if i >= len(lb):
+                    break
+                arr = lb[i]
+                if arr.ndim > 1 and arr.size == arr.shape[-1]:
+                    arr = arr.reshape(-1)
+                entry[name] = arr
+            if entry:
+                params[lp.name] = entry
     return params, state
 
 
@@ -223,9 +238,14 @@ def export_caffemodel(path: str, net, params, state=None) -> None:
                  np.asarray([1.0], np.float32)]
             )
         elif entry:
-            blobs.append(np.asarray(entry["weight"]))
-            if "bias" in entry:
-                blobs.append(np.asarray(entry["bias"]))
+            # blob order = the layer's declared param order (PReLU's
+            # single blob is "slope", Bias's is "bias")
+            order = getattr(
+                LAYER_IMPLS.get(t), "PARAM_ORDER", ("weight", "bias")
+            )
+            blobs.extend(
+                np.asarray(entry[name]) for name in order if name in entry
+            )
         if not blobs:
             continue
         layer_msg = (

@@ -12,6 +12,7 @@ caller explicitly materialises metrics).
 from __future__ import annotations
 
 import os
+from collections import deque
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -170,7 +171,8 @@ class Solver:
         self.params, self.state = self.train_net.init(init_rng)
         self.opt_state = init_opt_state(solver, self.params)
         self.iter = 0
-        self._loss_window: list = []  # average_loss display smoothing
+        # average_loss display smoothing; deque(maxlen) evicts itself
+        self._loss_window = deque(maxlen=max(1, solver.average_loss))
         self._train_step = jax.jit(
             make_train_step(self.train_net, solver), donate_argnums=(0, 1, 2)
         )
@@ -212,20 +214,19 @@ class Solver:
     def _push_loss(self, metrics) -> None:
         """Record this iteration's loss for ``average_loss`` smoothing
         (device array held lazily; synced only at display time)."""
-        avg_n = max(1, self.sp.average_loss)
-        if avg_n > 1 and "loss" in metrics:
+        if self._loss_window.maxlen > 1 and "loss" in metrics:
             self._loss_window.append(metrics["loss"])
-            if len(self._loss_window) > avg_n:
-                self._loss_window.pop(0)
 
     def _smoothed(self, metrics) -> Dict[str, float]:
-        """Metrics as floats, with ``loss`` averaged over the window."""
+        """Metrics as floats, with ``loss`` averaged over the window.
+        Window entries are converted to host floats on first read and
+        cached, so repeated displays don't re-fetch old scalars."""
         out = {k: float(v) for k, v in metrics.items()}
         if self._loss_window:
-            out["loss"] = float(
-                sum(float(x) for x in self._loss_window)
-                / len(self._loss_window)
-            )
+            for i, x in enumerate(self._loss_window):
+                if not isinstance(x, float):
+                    self._loss_window[i] = float(x)
+            out["loss"] = sum(self._loss_window) / len(self._loss_window)
         return out
 
     # -- snapshot / restore (Caffe .solverstate parity) ------------------
@@ -252,7 +253,7 @@ class Solver:
         st = snapshot.load_state(path)
         self.iter = int(st["it"])
         self.rng = jnp.asarray(st["rng"])
-        self._loss_window = []  # a restarted Caffe starts empty
+        self._loss_window.clear()  # a restarted Caffe starts empty
         self.params, self.state, self.opt_state = self._place_restored(
             st["params"], st["state"], st["opt_state"]
         )

@@ -243,3 +243,45 @@ def test_legacy_v1_layers_field():
     name, blobs = caffemodel.load_caffemodel(net)
     assert name == "v1net"
     np.testing.assert_array_equal(blobs["convA"][0], conv_w)
+
+
+def test_prelu_bias_embed_interchange(tmp_path):
+    """Single-blob layers with non-'weight' param names (PReLU slope,
+    Bias bias) and Embed round-trip through .caffemodel import/export
+    into the params XLANet actually reads."""
+    net_txt = """
+name: "pb"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "ids" type: "Input" top: "ids" }
+layer { name: "act" type: "PReLU" bottom: "data" top: "act" }
+layer { name: "sh" type: "Bias" bottom: "act" top: "sh" }
+layer { name: "emb" type: "Embed" bottom: "ids" top: "emb"
+        embed_param { num_output: 3 input_dim: 5
+          weight_filler { type: "gaussian" std: 1.0 } } }
+"""
+    npm = caffe_pb.load_net(net_txt, is_path=False)
+    net = XLANet(npm, "TRAIN", {"data": (2, 4), "ids": (2,)})
+    import jax
+
+    params, state = net.init(jax.random.PRNGKey(0))
+    # give recognisable values, export, then re-import
+    params = {k: {n: jnp.asarray(np.arange(v.size, dtype=np.float32).reshape(v.shape) + i)
+                  for i, (n, v) in enumerate(sorted(p.items()))}
+              for k, p in params.items()}
+    out = str(tmp_path / "pb.caffemodel")
+    caffemodel.export_caffemodel(out, net, params)
+    imported, _ = caffemodel.import_caffemodel(open(out, "rb").read(), net)
+    assert set(imported["act"]) == {"slope"}
+    assert set(imported["sh"]) == {"bias"}
+    np.testing.assert_allclose(
+        imported["act"]["slope"], np.asarray(params["act"]["slope"]).reshape(-1)
+    )
+    np.testing.assert_allclose(
+        imported["sh"]["bias"], np.asarray(params["sh"]["bias"]).reshape(-1)
+    )
+    # Embed keeps its (input_dim, num_output) table through the generic path
+    got = caffemodel.merge_into(jax.device_get(net.init(jax.random.PRNGKey(1))[0]), imported)
+    assert got["emb"]["weight"].shape == (5, 3)
+    np.testing.assert_allclose(
+        got["emb"]["weight"], np.asarray(params["emb"]["weight"]), rtol=1e-6
+    )
