@@ -12,13 +12,14 @@ only per-chip reference number available with the reference mount empty
 computed against that.
 
 Env knobs:
-  BENCH_MODEL=alexnet|bert   model under test (default alexnet)
+  BENCH_MODEL=alexnet|googlenet|resnet50|vgg16|bert
+                             model under test (default alexnet)
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
-  BENCH_INPUT_PIPELINE=1     alexnet only: feed fresh host batches
+  BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
                              through the preprocessing path each step
-                             (end-to-end mode) instead of one resident
-                             device batch (compute-only mode)
+                             (end-to-end mode, arch crop size) instead
+                             of one resident device batch (compute-only)
 
 The JSON line always appears, even on backend-init failure (the r01
 regression): errors fall back to CPU, and a terminal failure still
@@ -27,6 +28,7 @@ emits ``{"value": 0.0, "error": ...}``.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -53,6 +55,12 @@ def _first_device():
         return jax.devices()[0]
 
 
+def _fence(m) -> None:
+    """Host sync on any metric value — loss tops are named per-net
+    (e.g. GoogLeNet's 'loss3/loss'), so don't assume a 'loss' key."""
+    float(next(iter(m.values())))
+
+
 def _step_flops(solver, batch) -> float | None:
     """Actual per-step FLOPs of the compiled train step (fwd+bwd+update)
     from XLA cost analysis; None if the backend doesn't report it."""
@@ -67,20 +75,28 @@ def _step_flops(solver, batch) -> float | None:
     )
 
 
-# Analytic fallbacks: training ~= 3x forward FLOPs.
-ALEXNET_TRAIN_FLOPS_PER_IMG = 3 * 2 * 714e6  # 714 MMACs fwd (bvlc_alexnet@227)
+# Per-arch: (solver prototxt, input size, analytic fwd-MACs fallback,
+# default TPU batch). Training FLOPs fallback ~= 3 * 2 * MACs (fwd+bwd);
+# XLA cost analysis supplies the real number when the backend reports it.
+IMAGENET_ARCHS = {
+    "alexnet": ("bvlc_alexnet_solver.prototxt", 227, 714e6, 512),
+    "googlenet": ("bvlc_googlenet_quick_solver.prototxt", 224, 1580e6, 256),
+    "resnet50": ("resnet50_solver.prototxt", 224, 3860e6, 256),
+    "vgg16": ("vgg16_solver.prototxt", 224, 15470e6, 128),
+}
 
 
-def bench_alexnet(platform: str) -> dict:
+def bench_imagenet(platform: str, arch: str = "alexnet") -> dict:
     from sparknet_tpu.proto import caffe_pb
     from sparknet_tpu.solver.trainer import Solver
 
+    proto, size, fwd_macs, tpu_bs = IMAGENET_ARCHS[arch]
     zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
-    sp = caffe_pb.load_solver(os.path.join(zoo, "bvlc_alexnet_solver.prototxt"))
+    sp = caffe_pb.load_solver(os.path.join(zoo, proto))
 
-    bs = int(os.environ.get("BENCH_BATCH", 512 if platform != "cpu" else 16))
+    bs = int(os.environ.get("BENCH_BATCH", tpu_bs if platform != "cpu" else 16))
     compute_dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
-    shapes = {"data": (bs, 227, 227, 3), "label": (bs,)}
+    shapes = {"data": (bs, size, size, 3), "label": (bs,)}
     solver = Solver(sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype)
 
     rng = np.random.default_rng(0)
@@ -94,11 +110,13 @@ def bench_alexnet(platform: str) -> dict:
 
         ds = imagenet_dataset(None, train=True, synthetic_n=max(2048, 2 * bs))
         tf = Transformer(
-            mean_values=list(BGR_MEAN), crop_size=227, mirror=True, train=True
+            mean_values=list(BGR_MEAN), crop_size=size, mirror=True, train=True
         )
         # "native" -> C++ threaded prefetch loader; else host-python path
         make = make_native_feed if pipeline_mode == "native" else make_feed
-        feed_iter = make(ds, tf, bs, seed=0)
+        from sparknet_tpu.data.prefetch import prefetch_to_device
+
+        feed_iter = prefetch_to_device(make(ds, tf, bs, seed=0), size=2)
         feed = lambda: feed_iter
     else:
         batch = {
@@ -115,26 +133,31 @@ def bench_alexnet(platform: str) -> dict:
     # device->host read of a value data-dependent on the full step chain
     # is the only reliable fence.
     m = solver.step(feed(), 2)  # warmup + compile
-    float(m["loss"])
+    _fence(m)
 
     flops_batch = _step_flops(solver, next(feed()))
     if flops_batch is None:
-        flops_batch = ALEXNET_TRAIN_FLOPS_PER_IMG * bs
+        flops_batch = 3 * 2 * fwd_macs * bs  # train ~= 3x forward
 
     iters = int(os.environ.get("BENCH_ITERS", 20 if platform != "cpu" else 4))
     t0 = time.perf_counter()
     m = solver.step(feed(), iters)
-    float(m["loss"])
+    _fence(m)
     dt = time.perf_counter() - t0
 
     img_per_sec = bs * iters / dt
     tflops = flops_batch * iters / dt / 1e12
     peak = device_peak_flops(jax.devices()[0])
     return {
-        "metric": "alexnet_train_images_per_sec_per_chip",
+        "metric": f"{arch}_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / CAFFE_K40_ALEXNET_IMG_PER_SEC, 3),
+        # the Caffe-K40 anchor is an AlexNet number; other archs have
+        # no published per-chip reference figure
+        "vs_baseline": (
+            round(img_per_sec / CAFFE_K40_ALEXNET_IMG_PER_SEC, 3)
+            if arch == "alexnet" else None
+        ),
         "platform": platform,
         "batch_size": bs,
         "iters": iters,
@@ -228,7 +251,16 @@ def main() -> None:
     platform = _first_device().platform
     mode = os.environ.get("BENCH_MODEL", "alexnet")
     profile_dir = os.environ.get("BENCH_PROFILE")
-    runner = {"alexnet": bench_alexnet, "bert": bench_bert}[mode]
+    if mode == "bert":
+        runner = bench_bert
+    elif mode in IMAGENET_ARCHS:
+        runner = functools.partial(bench_imagenet, arch=mode)
+    else:
+        # ValueError (not SystemExit): the __main__ wrapper catches
+        # Exception and still emits the JSON error record
+        raise ValueError(
+            f"BENCH_MODEL={mode!r}: want bert|{'|'.join(IMAGENET_ARCHS)}"
+        )
     if profile_dir:
         with jax.profiler.trace(profile_dir):
             out = runner(platform)
@@ -245,18 +277,20 @@ if __name__ == "__main__":
             platform = jax.devices()[0].platform
         except Exception:
             platform = "none"
-        bert = os.environ.get("BENCH_MODEL", "alexnet") == "bert"
+        mode = os.environ.get("BENCH_MODEL", "alexnet")
+        bert = mode == "bert"
+        arch = mode if mode in IMAGENET_ARCHS else "alexnet"
         print(
             json.dumps(
                 {
                     "metric": (
                         "bert_base_mlm_tokens_per_sec_per_chip"
                         if bert
-                        else "alexnet_train_images_per_sec_per_chip"
+                        else f"{arch}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,
                     "unit": "tokens/sec" if bert else "images/sec",
-                    "vs_baseline": None if bert else 0.0,
+                    "vs_baseline": 0.0 if mode == "alexnet" else None,
                     "platform": platform,
                     "error": f"{type(e).__name__}: {e}",
                 }

@@ -120,6 +120,9 @@ def build(args):
             mesh=make_mesh(), mode=args.parallel, tau=args.tau,
         )
     feed = mlm_feed(ds, feed_bs, cfg.vocab_size, max_preds, seed=args.seed)
+    from ..data.prefetch import maybe_prefetch
+
+    feed = maybe_prefetch(feed, args, args.parallel)
     return solver, feed, cfg
 
 
@@ -162,6 +165,8 @@ def parser() -> argparse.ArgumentParser:
                          "solverstate if one exists (preemption recovery)")
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="batches staged ahead on device (0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 

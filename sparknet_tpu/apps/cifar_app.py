@@ -242,6 +242,9 @@ def build(args) -> tuple:
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
+    from ..data.prefetch import maybe_prefetch
+
+    train_feed = maybe_prefetch(train_feed, args, parallel)
     return solver, train_feed, test_feed
 
 
@@ -358,6 +361,8 @@ def arg_parser() -> argparse.ArgumentParser:
                     help="initialise weights from a .caffemodel (finetune)")
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="batches staged ahead on device (0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 

@@ -1,0 +1,97 @@
+"""Device prefetch: overlap host preprocessing + H2D transfer with
+device compute.
+
+The apps' feeds run decode/augment in Python and hand numpy to the
+jitted step, which then blocks on the transfer — on a fast chip the
+loop becomes host-bound (the reference hides the same latency inside
+its C++ data-prefetch thread; SURVEY.md data layer). This wrapper moves
+``next(feed)`` + ``jax.device_put`` into a daemon worker thread with a
+bounded queue, so the next batches' preprocessing and transfers run
+while the device crunches the current one.
+
+Order-preserving (single worker pulling sequentially) and therefore
+bitwise-deterministic: the batch sequence is identical to the
+unwrapped iterator's. Not for multi-host global assembly —
+``make_array_from_process_local_data`` must stay on the main thread
+with identical ordering across processes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+_SENTINEL = object()
+
+
+def prefetch_to_device(
+    it: Iterator[Any],
+    size: int = 2,
+    put: Optional[Callable[[Any], Any]] = None,
+) -> Iterator[Any]:
+    """Yield ``put(next(it))`` with up to ``size`` results staged ahead
+    by a worker thread. ``put`` defaults to ``jax.device_put`` (async
+    dispatch: the transfer is enqueued, not awaited). Exceptions from
+    the source iterator re-raise at the consuming ``next()``; closing
+    or abandoning the generator stops the worker and releases its
+    staged batches (no thread or device memory pinned past the feed's
+    lifetime)."""
+    if size <= 0:
+        for b in it:
+            yield (put or jax.device_put)(b)
+        return
+    putter = put or jax.device_put
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for b in it:
+                staged = putter(b)
+                while not stop.is_set():
+                    try:
+                        q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            q.put((_SENTINEL, e))
+            return
+        q.put((_SENTINEL, None))
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if (
+                isinstance(item, tuple)
+                and len(item) == 2
+                and item[0] is _SENTINEL
+            ):
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()
+        while not q.empty():  # drop staged batches so they can free
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
+def maybe_prefetch(feed, args, parallel: str):
+    """Stage host preprocessing + H2D ahead of the step loop (single
+    -process solvers only: multi-host global assembly must stay on the
+    main thread; order-preserving, so determinism is unchanged).
+    Shared by every app; ``--prefetch 0`` disables."""
+    size = getattr(args, "prefetch", 2)
+    if size and parallel == "none" and jax.process_count() == 1:
+        return prefetch_to_device(feed, size=size)
+    return feed

@@ -78,3 +78,76 @@ def test_cifar_binary_decode_and_synthetic():
     assert mean.shape == (32, 32, 3)
     batch = next(ds.batches(16, epochs=1))
     assert batch["data"].shape == (16, 32, 32, 3)
+
+
+def test_prefetch_to_device_preserves_sequence_and_errors():
+    """The device-prefetch wrapper must be order-preserving (bitwise
+    determinism) and relay source-iterator exceptions."""
+    import numpy as np
+
+    from sparknet_tpu.data.prefetch import prefetch_to_device
+
+    src = [{"data": np.full((2, 2), i, np.float32), "label": np.array([i])}
+           for i in range(7)]
+    got = list(prefetch_to_device(iter(src), size=3))
+    assert len(got) == 7
+    for i, b in enumerate(got):
+        np.testing.assert_array_equal(np.asarray(b["data"]), src[i]["data"])
+
+    # size=0 disables the thread but still places on device
+    got0 = list(prefetch_to_device(iter(src[:2]), size=0))
+    assert len(got0) == 2
+
+    def boom():
+        yield src[0]
+        raise RuntimeError("feed died")
+
+    it = prefetch_to_device(boom(), size=2)
+    next(it)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="feed died"):
+        next(it)
+
+
+def test_prefetch_training_is_bit_identical():
+    """Training through the prefetch wrapper must produce bitwise the
+    same weights as the raw feed (same batch order, same math)."""
+    import numpy as np
+    import jax
+
+    from sparknet_tpu.data.prefetch import prefetch_to_device
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    net_txt = """
+name: "pf"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 3
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+    sp_txt = "base_lr: 0.1\nlr_policy: \"fixed\"\nmomentum: 0.9\nmax_iter: 5\n"
+
+    def feed():
+        rng = np.random.default_rng(7)
+        while True:
+            yield {
+                "data": rng.normal(size=(4, 6)).astype(np.float32),
+                "label": rng.integers(0, 3, 4).astype(np.int32),
+            }
+
+    results = []
+    for wrap in (False, True):
+        sp = caffe_pb.load_solver(sp_txt, is_path=False)
+        sp.net_param = caffe_pb.load_net(net_txt, is_path=False)
+        solver = Solver(sp, {"data": (4, 6), "label": (4,)})
+        f = prefetch_to_device(feed(), size=2) if wrap else feed()
+        solver.step(f, 5)
+        results.append(jax.device_get(solver.params))
+    a, b = results
+    for layer in a:
+        for name in a[layer]:
+            np.testing.assert_array_equal(a[layer][name], b[layer][name])

@@ -91,3 +91,13 @@ def test_bench_bert_emits_json(monkeypatch, capsys):
     rec = _run_bench(capsys)
     assert rec["metric"] == "bert_base_mlm_tokens_per_sec_per_chip"
     assert rec["value"] > 0 and "error" not in rec
+
+
+def test_bench_resnet50_emits_json(monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_MODEL", "resnet50")
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    rec = _run_bench(capsys)
+    assert rec["metric"] == "resnet50_train_images_per_sec_per_chip"
+    assert rec["value"] > 0 and "error" not in rec
+    assert rec["vs_baseline"] is None  # the K40 anchor is AlexNet-only
