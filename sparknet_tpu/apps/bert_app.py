@@ -74,9 +74,19 @@ def build(args):
         )
     if args.remat:
         overrides["remat"] = True
+    if args.max_position:
+        # long-context: grow the position table past BERT's 512 (pair
+        # with --attention flash [+ --remat]; the streamed kernels keep
+        # VMEM O(block) at any S — S=32k fwd+bwd measured on v5e)
+        overrides["max_position"] = args.max_position
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     seq = args.seq_len or min(128, cfg.max_position)
+    if seq > cfg.max_position:
+        raise ValueError(
+            f"--seq-len {seq} exceeds max_position {cfg.max_position}; "
+            f"raise --max-position"
+        )
     bs = args.batch_size
     max_preds = max(1, int(seq * 0.15) + 1)
 
@@ -120,9 +130,6 @@ def build(args):
             mesh=make_mesh(), mode=args.parallel, tau=args.tau,
         )
     feed = mlm_feed(ds, feed_bs, cfg.vocab_size, max_preds, seed=args.seed)
-    from ..data.prefetch import maybe_prefetch
-
-    feed = maybe_prefetch(feed, args, args.parallel)
     return solver, feed, cfg
 
 
@@ -132,6 +139,9 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--vocab-size", type=int, default=0,
                     help="override config vocab size")
     ap.add_argument("--seq-len", type=int, default=0)
+    ap.add_argument("--max-position", type=int, default=0,
+                    help="override the position-embedding table size "
+                         "(long-context; combine with --attention flash)")
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--max-iter", type=int, default=1000)
     ap.add_argument("--lr", type=float, default=1e-4)
@@ -180,6 +190,10 @@ def main(argv=None) -> Dict[str, float]:
     apply_auto_resume(args, args.snapshot_prefix)
     if args.restore:
         solver.restore(args.restore, feed)
+    # wrap AFTER restore (see cifar_app.main)
+    from ..data.prefetch import maybe_prefetch
+
+    feed = maybe_prefetch(feed, args, args.parallel)
     primary = multihost.is_primary()
     if primary:
         if args.restore:

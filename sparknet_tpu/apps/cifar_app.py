@@ -242,9 +242,6 @@ def build(args) -> tuple:
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
-    from ..data.prefetch import maybe_prefetch
-
-    train_feed = maybe_prefetch(train_feed, args, parallel)
     return solver, train_feed, test_feed
 
 
@@ -379,6 +376,11 @@ def main(argv=None):
     apply_auto_resume(args, solver.sp.snapshot_prefix)
     if args.restore:
         solver.restore(args.restore, train_feed)
+    # wrap AFTER restore: align_feed fast-forwards skipped batches,
+    # which must stay host-side (and skippable), not device transfers
+    from ..data.prefetch import maybe_prefetch
+
+    train_feed = maybe_prefetch(train_feed, args, args.parallel)
     if multihost.is_primary():
         if args.restore:
             print(f"Restoring previous solver status from {args.restore} "

@@ -172,3 +172,26 @@ def test_mlm_feed_shapes():
     assert b["mlm_positions"].shape == (8, 5)
     assert b["attention_mask"].dtype == np.int32
     assert (b["mlm_weights"].sum(1) >= 1).all()
+
+
+def test_bert_app_long_context_max_position():
+    """--max-position grows the position table past BERT's 512 so long
+    sequences train; an overlong --seq-len without it errors clearly."""
+    import pytest
+
+    from sparknet_tpu.apps import bert_app
+
+    solver, feed, cfg = bert_app.build(
+        bert_app.make_args(
+            config="tiny", seq_len=256, max_position=256, batch_size=2,
+            max_iter=1,
+        )
+    )
+    assert cfg.max_position == 256
+    m = solver.step(feed, 1)
+    assert float(m["loss"]) > 0
+
+    with pytest.raises(ValueError, match="max_position"):
+        bert_app.build(
+            bert_app.make_args(config="tiny", seq_len=512, batch_size=2)
+        )
