@@ -119,13 +119,25 @@ class ShardedDataset:
         epochs: Optional[int] = None,
         drop_remainder: bool = True,
         transform: Optional[Callable[[Any, np.random.Generator], Any]] = None,
-    ) -> Iterator[Any]:
-        """Yield batches cycling over partitions (and epochs).
+    ) -> "BatchIterator":
+        """Batches cycling over partitions (and epochs), as a
+        :class:`BatchIterator` (a normal iterator plus ``skip(n)``).
 
         ``transform`` runs per-batch on host (augmentation) with a
-        per-batch RNG derived from (seed, epoch, step) — deterministic
-        and recomputable, like the rest of the lineage.
+        per-batch RNG derived from ``(seed, epoch, batch-index)`` —
+        independent of consumption history, so any batch's augmentation
+        is recomputable in isolation (lineage) and ``skip`` can omit
+        the transform for batches nobody will see.
         """
+        return BatchIterator(
+            self, batch_size, shuffle=shuffle, seed=seed, epochs=epochs,
+            drop_remainder=drop_remainder, transform=transform,
+        )
+
+    def _iter_batches(
+        self, batch_size, *, shuffle, seed, epochs, drop_remainder,
+        transform, skip_box,
+    ) -> Iterator[Any]:
         epoch = 0
         while epochs is None or epoch < epochs:
             order = np.arange(len(self._fns))
@@ -137,10 +149,19 @@ class ShardedDataset:
             # iterator); leftover rows drop only at epoch end.
             buf: Any = None
             yielded = False
+            bi = 0
 
             def emit(batch):
+                if skip_box[0] > 0:
+                    # skipped batches drop before their (expensive)
+                    # transform; correctness holds because the
+                    # transform rng is per-batch, not stateful
+                    skip_box[0] -= 1
+                    return None
                 if transform is not None:
-                    batch = transform(batch, rng)
+                    batch = transform(
+                        batch, np.random.default_rng((seed, epoch, bi))
+                    )
                 return batch
 
             for pi in order:
@@ -165,7 +186,10 @@ class ShardedDataset:
                     else:
                         batch = buf[lo : lo + batch_size]
                     yielded = True
-                    yield emit(batch)
+                    out = emit(batch)
+                    bi += 1
+                    if out is not None:
+                        yield out
                     lo += batch_size
                 buf = (
                     {k: buf[k][lo:] for k in keys} if keys else buf[lo:]
@@ -173,10 +197,40 @@ class ShardedDataset:
             rem = len(buf[list(buf)[0]] if isinstance(buf, dict) else buf) if buf is not None else 0
             if rem and not drop_remainder:
                 yielded = True
-                yield emit(buf)
+                out = emit(buf)
+                bi += 1
+                if out is not None:
+                    yield out
             if not yielded:
                 raise ValueError(
                     f"dataset yields no batches: total rows per epoch < "
                     f"batch_size={batch_size}"
                 )
             epoch += 1
+
+
+class BatchIterator:
+    """Iterator over :meth:`ShardedDataset.batches` with ``skip(n)``:
+    skipped batches never run their transform (the dominant host cost),
+    they are only sliced and discarded — valid because augmentation RNG
+    is derived per batch, not threaded through consumption."""
+
+    def __init__(self, ds, batch_size, **kw):
+        self._skip_box = [0]
+        self._it = ds._iter_batches(
+            batch_size, skip_box=self._skip_box, **kw
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def skip(self, n: int) -> None:
+        """Fast-forward past the next ``n`` batches. Lazy: the budget
+        is consumed inside the generator (slice-and-discard, no
+        transform) when the consumer next pulls, so skip itself is
+        O(1)."""
+        if n > 0:
+            self._skip_box[0] += n

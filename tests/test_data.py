@@ -151,3 +151,35 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "
     for layer in a:
         for name in a[layer]:
             np.testing.assert_array_equal(a[layer][name], b[layer][name])
+
+
+def test_batch_iterator_skip_matches_consumed():
+    """skip(n) must position the feed exactly where n next() calls
+    would, including the per-batch transform RNG (resume contract)."""
+    import numpy as np
+
+    from sparknet_tpu.data.rdd import ShardedDataset
+
+    rng = np.random.default_rng(0)
+    ds = ShardedDataset.from_arrays(
+        {"data": rng.normal(size=(40, 3)).astype(np.float32),
+         "label": np.arange(40, dtype=np.int32)},
+        num_partitions=4,
+    )
+
+    def aug(batch, r):
+        return {
+            "data": batch["data"] + r.normal(size=batch["data"].shape),
+            "label": batch["label"],
+        }
+
+    a = ds.batches(8, shuffle=True, seed=3, transform=aug)
+    for _ in range(5):  # crosses an epoch boundary (5 batches/epoch)
+        next(a)
+    want = next(a)
+
+    b = ds.batches(8, shuffle=True, seed=3, transform=aug)
+    b.skip(5)
+    got = next(b)
+    np.testing.assert_array_equal(got["label"], want["label"])
+    np.testing.assert_allclose(got["data"], want["data"], rtol=1e-6)
