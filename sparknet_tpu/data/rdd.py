@@ -26,6 +26,10 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+# distinct skip marker: None must stay a loud error if a user transform
+# forgets its return value
+_SKIPPED = object()
+
 
 class ShardedDataset:
     """A list of lazily-evaluated partitions with RDD-style combinators."""
@@ -157,7 +161,7 @@ class ShardedDataset:
                     # transform; correctness holds because the
                     # transform rng is per-batch, not stateful
                     skip_box[0] -= 1
-                    return None
+                    return _SKIPPED
                 if transform is not None:
                     batch = transform(
                         batch, np.random.default_rng((seed, epoch, bi))
@@ -188,7 +192,7 @@ class ShardedDataset:
                     yielded = True
                     out = emit(batch)
                     bi += 1
-                    if out is not None:
+                    if out is not _SKIPPED:
                         yield out
                     lo += batch_size
                 buf = (
@@ -199,7 +203,7 @@ class ShardedDataset:
                 yielded = True
                 out = emit(buf)
                 bi += 1
-                if out is not None:
+                if out is not _SKIPPED:
                     yield out
             if not yielded:
                 raise ValueError(

@@ -56,6 +56,8 @@ LOSS_LAYER_TYPES = {
     "EuclideanLoss",
     "HingeLoss",
     "ContrastiveLoss",
+    "MultinomialLogisticLoss",
+    "InfogainLoss",
 }
 
 
@@ -942,12 +944,19 @@ class Accuracy:
         logits, labels = inputs[0], inputs[1].astype(jnp.int32)
         p = lp.sub("accuracy_param")
         top_k = int(p.get("top_k", 1)) if p else 1
+        ignore = p.get("ignore_label") if p else None
         if top_k == 1:
             correct = jnp.argmax(logits, -1) == labels
         else:
             _, idx = lax.top_k(logits, top_k)
             correct = jnp.any(idx == labels[:, None], axis=-1)
-        acc = jnp.mean(correct.astype(jnp.float32))
+        if ignore is not None:
+            valid = labels != int(ignore)
+            acc = jnp.sum(
+                jnp.where(valid, correct, False).astype(jnp.float32)
+            ) / jnp.maximum(jnp.sum(valid), 1)
+        else:
+            acc = jnp.mean(correct.astype(jnp.float32))
         outs = [acc] * max(1, len(lp.top))
         return outs, None
 
@@ -1252,6 +1261,207 @@ class Silence:
         return [], None
 
 
+class LSTM:
+    """Caffe's LSTMLayer: time-major input x (T, N, ...) plus sequence
+    -continuation markers cont (T, N) (0 at sequence starts resets the
+    state, so packed batches of variable-length sequences train
+    correctly). One ``lax.scan`` over T — the TPU-native unrolling;
+    gate order i, f, o, g matches Caffe's blob layout, and the blobs
+    are [W_xc (in,4H), b (4H), W_hc (H,4H)] via PARAM_ORDER."""
+
+    PARAM_ORDER = ("weight", "bias", "hidden_weight")
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.sub("recurrent_param")
+        h = int(p.get("num_output"))
+        if p.get("expose_hidden"):
+            raise NotImplementedError(
+                f"layer {lp.name!r}: recurrent expose_hidden unsupported"
+            )
+        return h, p
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        h, _ = LSTM._geom(lp)
+        t, n = in_shapes[0][:2]
+        return [(t, n, h)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        h, p = LSTM._geom(lp)
+        cin = int(np.prod(in_shapes[0][2:]))
+        wf = Filler.from_message(p.get("weight_filler"))
+        bf = Filler.from_message(p.get("bias_filler"))
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            "weight": fill(wf, k1, (cin, 4 * h), cin, 4 * h),
+            "bias": fill(bf, k2, (4 * h,), cin, 4 * h),
+            "hidden_weight": fill(wf, k3, (h, 4 * h), h, 4 * h),
+        }
+
+    @staticmethod
+    def _cont(inputs, t, n, dtype):
+        if len(inputs) > 1:
+            return inputs[1].astype(dtype).reshape(t, n)
+        # no cont bottom: one unbroken sequence per batch row (first
+        # step still starts from the zero state)
+        return jnp.ones((t, n), dtype).at[0].set(0.0)
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        hs, _ = LSTM._geom(lp)
+        x = inputs[0]
+        t, n = x.shape[:2]
+        cdt = ctx.compute_dtype
+        x = x.reshape(t, n, -1).astype(cdt)
+        cont = LSTM._cont(inputs, t, n, jnp.float32)
+        wx = params["weight"].astype(cdt)
+        wh = params["hidden_weight"].astype(cdt)
+        b = params["bias"]
+        # input contribution for every step in one batched matmul
+        gx = (
+            jnp.dot(x, wx, preferred_element_type=jnp.float32) + b
+        )  # (T, N, 4H) f32
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            gxt, ct = inp
+            h_in = (h_prev * ct[:, None]).astype(cdt)
+            gates = gxt + jnp.dot(
+                h_in, wh, preferred_element_type=jnp.float32
+            )
+            i, f, o, g = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            o = jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = ct[:, None] * (f * c_prev) + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        zeros = jnp.zeros((n, hs), jnp.float32)
+        _, hseq = lax.scan(step, (zeros, zeros), (gx, cont))
+        return [hseq.astype(cdt)], None
+
+
+class RNN(LSTM):
+    """Caffe's RNNLayer: h_t = tanh(W_xh x_t + b_h + W_hh h_{t-1}),
+    o_t = tanh(W_ho h_t + b_o); blobs [W_xh, b_h, W_hh, W_ho, b_o]."""
+
+    PARAM_ORDER = (
+        "weight", "bias", "hidden_weight", "out_weight", "out_bias"
+    )
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        h, p = LSTM._geom(lp)
+        cin = int(np.prod(in_shapes[0][2:]))
+        wf = Filler.from_message(p.get("weight_filler"))
+        bf = Filler.from_message(p.get("bias_filler"))
+        ks = jax.random.split(rng, 5)
+        return {
+            "weight": fill(wf, ks[0], (cin, h), cin, h),
+            "bias": fill(bf, ks[1], (h,), cin, h),
+            "hidden_weight": fill(wf, ks[2], (h, h), h, h),
+            "out_weight": fill(wf, ks[3], (h, h), h, h),
+            "out_bias": fill(bf, ks[4], (h,), h, h),
+        }
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        hs, _ = LSTM._geom(lp)
+        x = inputs[0]
+        t, n = x.shape[:2]
+        cdt = ctx.compute_dtype
+        x = x.reshape(t, n, -1).astype(cdt)
+        cont = LSTM._cont(inputs, t, n, jnp.float32)
+        wx = params["weight"].astype(cdt)
+        wh = params["hidden_weight"].astype(cdt)
+        wo = params["out_weight"].astype(cdt)
+        gx = jnp.dot(x, wx, preferred_element_type=jnp.float32) + params["bias"]
+
+        def step(h_prev, inp):
+            gxt, ct = inp
+            h_in = (h_prev * ct[:, None]).astype(cdt)
+            h = jnp.tanh(
+                gxt + jnp.dot(h_in, wh, preferred_element_type=jnp.float32)
+            )
+            o = jnp.tanh(
+                jnp.dot(h.astype(cdt), wo, preferred_element_type=jnp.float32)
+                + params["out_bias"]
+            )
+            return h, o
+
+        zeros = jnp.zeros((n, hs), jnp.float32)
+        _, oseq = lax.scan(step, zeros, (gx, cont))
+        return [oseq.astype(cdt)], None
+
+
+class MultinomialLogisticLoss:
+    """NLL over already-softmaxed probabilities (Caffe pairs it with an
+    explicit Softmax layer; SoftmaxWithLoss is the fused form)."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        probs = inputs[0].astype(jnp.float32)
+        labels = inputs[1].astype(jnp.int32).reshape(-1)
+        p = jnp.take_along_axis(
+            probs.reshape(labels.shape[0], -1), labels[:, None], axis=-1
+        )[:, 0]
+        # Caffe clamps at kLOG_THRESHOLD=1e-20
+        return [-jnp.mean(jnp.log(jnp.maximum(p, 1e-20)))], None
+
+
+class InfogainLoss:
+    """NLL weighted by an infogain matrix H (bottom[2] or
+    ``infogain_loss_param.source`` .binaryproto); H=I reduces to
+    MultinomialLogisticLoss."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [()]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def _matrix(lp, inputs, n_classes):
+        if len(inputs) == 3:
+            return inputs[2].astype(jnp.float32).reshape(n_classes, n_classes)
+        p = lp.sub("infogain_loss_param")
+        src = str(p.get("source")) if p and p.get("source") else None
+        if src is None:
+            raise ValueError(
+                f"layer {lp.name!r}: InfogainLoss needs a third bottom or "
+                f"infogain_loss_param.source"
+            )
+        from ..proto.caffemodel import load_binaryproto_mean
+
+        h = load_binaryproto_mean(src)
+        return jnp.asarray(h, jnp.float32).reshape(n_classes, n_classes)
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        probs = inputs[0].astype(jnp.float32)
+        labels = inputs[1].astype(jnp.int32).reshape(-1)
+        probs = probs.reshape(labels.shape[0], -1)
+        h = InfogainLoss._matrix(lp, inputs, probs.shape[-1])
+        logp = jnp.log(jnp.maximum(probs, 1e-20))
+        # loss_i = -sum_j H[label_i, j] * log p_ij
+        rows = h[labels]  # (N, C)
+        return [-jnp.mean(jnp.sum(rows * logp, axis=-1))], None
+
+
 class HingeLoss:
     """One-vs-all hinge over (N, C) scores: t=+1 at the label, -1
     elsewhere; L1 or squared (L2) norm, averaged over N."""
@@ -1347,4 +1557,8 @@ LAYER_IMPLS = {
     "Silence": Silence,
     "HingeLoss": HingeLoss,
     "ContrastiveLoss": ContrastiveLoss,
+    "MultinomialLogisticLoss": MultinomialLogisticLoss,
+    "InfogainLoss": InfogainLoss,
+    "LSTM": LSTM,
+    "RNN": RNN,
 }

@@ -156,6 +156,15 @@ def import_caffemodel(path_or_bytes, net) -> Tuple[Dict, Dict]:
             if len(lb) > 1:
                 entry["bias"] = lb[1].reshape(-1)
             params[lp.name] = entry
+        elif t in ("LSTM", "RNN"):
+            # Caffe recurrent blobs are (out, in) matrices; ours are
+            # (in, out) so matmuls run untransposed in the hot loop
+            order = LAYER_IMPLS[t].PARAM_ORDER
+            params[lp.name] = {
+                name: (lb[i].T if lb[i].ndim == 2 else lb[i].reshape(-1))
+                for i, name in enumerate(order)
+                if i < len(lb)
+            }
         else:
             # generic path: blob i maps to the layer's i-th declared
             # param name (PReLU: slope; Bias: bias; default
@@ -237,6 +246,13 @@ def export_caffemodel(path: str, net, params, state=None) -> None:
                 [np.asarray(st["mean"]), np.asarray(st["var"]),
                  np.asarray([1.0], np.float32)]
             )
+        elif t in ("LSTM", "RNN") and entry:
+            # invert the import transpose: (in, out) -> Caffe (out, in)
+            order = LAYER_IMPLS[t].PARAM_ORDER
+            for name in order:
+                if name in entry:
+                    arr = np.asarray(entry[name])
+                    blobs.append(arr.T if arr.ndim == 2 else arr)
         elif entry:
             # blob order = the layer's declared param order (PReLU's
             # single blob is "slope", Bias's is "bias")

@@ -285,3 +285,51 @@ layer { name: "emb" type: "Embed" bottom: "ids" top: "emb"
     np.testing.assert_allclose(
         got["emb"]["weight"], np.asarray(params["emb"]["weight"]), rtol=1e-6
     )
+
+
+def test_lstm_caffemodel_layout_round_trip(tmp_path):
+    """Recurrent blobs are (out, in) in Caffe; import must transpose to
+    our (in, out) and export must invert it — verified by writing a
+    Caffe-layout model by hand, importing, and re-exporting."""
+    import jax
+
+    net_txt = """
+name: "seq"
+layer { name: "x" type: "Input" top: "x" }
+layer { name: "lstm" type: "LSTM" bottom: "x" top: "lstm"
+        recurrent_param { num_output: 3
+          weight_filler { type: "xavier" } } }
+"""
+    npm = caffe_pb.load_net(net_txt, is_path=False)
+    net = XLANet(npm, "TRAIN", {"x": (4, 2, 5)})
+    rng = np.random.default_rng(9)
+    w_xc = rng.normal(size=(12, 5)).astype(np.float32)   # Caffe (4H, in)
+    b = rng.normal(size=(12,)).astype(np.float32)
+    w_hc = rng.normal(size=(12, 3)).astype(np.float32)   # Caffe (4H, H)
+    layer_msg = wire.encode_string_field(1, "lstm") + wire.encode_string_field(
+        2, "LSTM"
+    )
+    for arr in (w_xc, b, w_hc):
+        blob = caffemodel.wire.encode_packed_floats(5, arr.reshape(-1)) + \
+            wire.encode_bytes_field(
+                7,
+                b"".join(wire.encode_varint_field(1, d) for d in arr.shape),
+            )
+        layer_msg += wire.encode_bytes_field(7, blob)
+    payload = wire.encode_bytes_field(100, layer_msg)
+    imported, _ = caffemodel.import_caffemodel(payload, net)
+    np.testing.assert_allclose(imported["lstm"]["weight"], w_xc.T)
+    np.testing.assert_allclose(imported["lstm"]["hidden_weight"], w_hc.T)
+    np.testing.assert_allclose(imported["lstm"]["bias"], b)
+    # shapes now match the net's own params
+    init_params, _ = net.init(jax.random.PRNGKey(0))
+    for k, v in imported["lstm"].items():
+        assert v.shape == tuple(init_params["lstm"][k].shape), k
+
+    out = str(tmp_path / "seq.caffemodel")
+    caffemodel.export_caffemodel(
+        out, net, {"lstm": {k: jnp.asarray(v) for k, v in imported["lstm"].items()}}
+    )
+    _, blobs = caffemodel.load_caffemodel(open(out, "rb").read())
+    np.testing.assert_allclose(blobs["lstm"][0], w_xc, rtol=1e-6)
+    np.testing.assert_allclose(blobs["lstm"][2], w_hc, rtol=1e-6)

@@ -448,3 +448,172 @@ layer { name: "act" type: "PReLU" bottom: "ip" top: "act"
     lr, dec = mults_for_params(params, xnet.param_specs())
     assert lr["act"]["slope"] == 3.0
     assert dec["act"]["slope"] == 0.0
+
+
+def test_lstm_vs_torch():
+    """Caffe-gate-order LSTM vs torch.nn.LSTM (torch packs gates
+    i,f,g,o; Caffe i,f,o,g — remap and compare the full sequence)."""
+    rng = np.random.default_rng(20)
+    T, N, C, H = 6, 3, 5, 4
+    x = rng.normal(size=(T, N, C)).astype(np.float32)
+    wx = rng.normal(size=(C, 4 * H)).astype(np.float32) * 0.5
+    wh = rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.5
+    b = rng.normal(size=(4 * H,)).astype(np.float32) * 0.1
+
+    lp = lp_from(
+        'name: "l" type: "LSTM" recurrent_param { num_output: %d }' % H
+    )
+    cont = np.ones((T, N), np.float32)
+    cont[0] = 0.0  # sequence start
+    params = {
+        "weight": jnp.asarray(wx),
+        "bias": jnp.asarray(b),
+        "hidden_weight": jnp.asarray(wh),
+    }
+    (y,), _ = L.LSTM.apply(
+        lp, params, None, [jnp.asarray(x), jnp.asarray(cont)], CTX
+    )
+
+    m = torch.nn.LSTM(C, H)
+    # ours (in, 4H) caffe order [i,f,o,g] -> torch (4H, in) order [i,f,g,o]
+    def reorder(w4h):  # (.., 4H) caffe -> torch gate order
+        i, f, o, g = np.split(w4h, 4, axis=-1)
+        return np.concatenate([i, f, g, o], axis=-1)
+
+    with torch.no_grad():
+        m.weight_ih_l0.copy_(torch.from_numpy(reorder(wx).T))
+        m.weight_hh_l0.copy_(torch.from_numpy(reorder(wh).T))
+        m.bias_ih_l0.copy_(torch.from_numpy(reorder(b)))
+        m.bias_hh_l0.zero_()
+        ref, _ = m(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_cont_resets_state():
+    """cont=0 mid-sequence must equal restarting the net from zero
+    state at that step."""
+    rng = np.random.default_rng(21)
+    T, N, C, H = 8, 2, 3, 4
+    x = rng.normal(size=(T, N, C)).astype(np.float32)
+    lp = lp_from(
+        'name: "l" type: "LSTM" recurrent_param { num_output: %d '
+        'weight_filler { type: "gaussian" std: 0.5 } }' % H
+    )
+    params = L.LSTM.init(lp, jax.random.PRNGKey(3), [(T, N, C)])
+    cont = np.ones((T, N), np.float32)
+    cont[0] = 0.0
+    cont[5] = 0.0  # reset mid-stream
+    (y,), _ = L.LSTM.apply(
+        lp, params, None, [jnp.asarray(x), jnp.asarray(cont)], CTX
+    )
+    # restarted run over the tail only
+    cont_tail = np.ones((3, N), np.float32)
+    cont_tail[0] = 0.0
+    (y_tail,), _ = L.LSTM.apply(
+        lp, params, None, [jnp.asarray(x[5:]), jnp.asarray(cont_tail)], CTX
+    )
+    np.testing.assert_allclose(
+        np.asarray(y)[5:], np.asarray(y_tail), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_rnn_shapes_and_determinism():
+    rng = np.random.default_rng(22)
+    T, N, C, H = 5, 2, 3, 6
+    x = rng.normal(size=(T, N, C)).astype(np.float32)
+    lp = lp_from(
+        'name: "r" type: "RNN" recurrent_param { num_output: %d '
+        'weight_filler { type: "xavier" } }' % H
+    )
+    assert L.RNN.infer(lp, [(T, N, C)]) == [(T, N, H)]
+    params = L.RNN.init(lp, jax.random.PRNGKey(4), [(T, N, C)])
+    assert set(params) == {
+        "weight", "bias", "hidden_weight", "out_weight", "out_bias"
+    }
+    (y1,), _ = L.RNN.apply(lp, params, None, [jnp.asarray(x)], CTX)
+    (y2,), _ = L.RNN.apply(lp, params, None, [jnp.asarray(x)], CTX)
+    assert y1.shape == (T, N, H)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert np.abs(np.asarray(y1)).max() <= 1.0  # tanh output
+
+
+def test_multinomial_and_infogain_losses():
+    rng = np.random.default_rng(23)
+    probs = rng.dirichlet(np.ones(5), size=6).astype(np.float32)
+    labels = rng.integers(0, 5, 6)
+    lp = lp_from('name: "m" type: "MultinomialLogisticLoss"')
+    (l,), _ = L.MultinomialLogisticLoss.apply(
+        lp, {}, None, [jnp.asarray(probs), jnp.asarray(labels)], CTX
+    )
+    ref = -np.mean(np.log(probs[np.arange(6), labels]))
+    np.testing.assert_allclose(float(l), ref, rtol=1e-5)
+
+    # identity infogain == multinomial logistic
+    lp = lp_from('name: "i" type: "InfogainLoss"')
+    (li,), _ = L.InfogainLoss.apply(
+        lp, {}, None,
+        [jnp.asarray(probs), jnp.asarray(labels), jnp.eye(5)], CTX
+    )
+    np.testing.assert_allclose(float(li), ref, rtol=1e-5)
+    # a weighted H changes the loss accordingly
+    h = np.eye(5, dtype=np.float32) * 2.0
+    (l2,), _ = L.InfogainLoss.apply(
+        lp, {}, None,
+        [jnp.asarray(probs), jnp.asarray(labels), jnp.asarray(h)], CTX
+    )
+    np.testing.assert_allclose(float(l2), 2 * ref, rtol=1e-5)
+
+
+def test_accuracy_ignore_label():
+    logits = jnp.asarray(
+        [[2.0, 0.0], [0.0, 2.0], [2.0, 0.0], [0.0, 2.0]], jnp.float32
+    )
+    labels = jnp.asarray([0, 1, 1, 9], jnp.int32)  # 9 = ignored
+    lp = lp_from(
+        'name: "a" type: "Accuracy" accuracy_param { ignore_label: 9 }'
+    )
+    (acc,), _ = L.Accuracy.apply(lp, {}, None, [logits, labels], CTX)
+    # rows 0,1 correct; row 2 wrong; row 3 ignored -> 2/3
+    np.testing.assert_allclose(float(acc), 2.0 / 3.0, rtol=1e-6)
+
+
+def test_lstm_net_trains_through_xlanet():
+    """An LSTM net compiles and trains end-to-end through the XLANet
+    compiler + solver (time-major blobs flow through the DAG)."""
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    net_txt = """
+name: "seq"
+layer { name: "x" type: "Input" top: "x" }
+layer { name: "cont" type: "Input" top: "cont" }
+layer { name: "target" type: "Input" top: "target" }
+layer { name: "lstm" type: "LSTM" bottom: "x" bottom: "cont" top: "lstm"
+        recurrent_param { num_output: 8
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "lstm" bottom: "target" top: "loss" }
+"""
+    sp = caffe_pb.load_solver(
+        "base_lr: 0.05\nlr_policy: \"fixed\"\nmomentum: 0.9\nmax_iter: 20\n",
+        is_path=False,
+    )
+    sp.net_param = caffe_pb.load_net(net_txt, is_path=False)
+    T, N, C = 6, 4, 5
+    shapes = {"x": (T, N, C), "cont": (T, N), "target": (T, N, 8)}
+    solver = Solver(sp, shapes)
+    rng = np.random.default_rng(5)
+    cont = np.ones((T, N), np.float32)
+    cont[0] = 0.0
+    batch = {
+        "x": jnp.asarray(rng.normal(size=(T, N, C)), jnp.float32),
+        "cont": jnp.asarray(cont),
+        "target": jnp.asarray(rng.normal(size=(T, N, 8)) * 0.1, jnp.float32),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    first = float(solver.step(feed(), 1)["loss"])
+    last = float(solver.step(feed(), 19)["loss"])
+    assert np.isfinite(last) and last < first  # it learns the mapping
