@@ -101,10 +101,11 @@ def test_tp_sp_combined_trains():
     step = make_tp_train_step(model, sp, mesh, dp_axis="dp", tp_axis="tp",
                               sp_axis="sp")
     feed = _batch(b, s)
+    # fixed batch: memorisation is a deterministic learning signal
+    batch = {k: jnp.asarray(v) for k, v in next(feed).items()}
     losses = []
     rng = jax.random.PRNGKey(2)
     for it in range(10):
-        batch = {k: jnp.asarray(v) for k, v in next(feed).items()}
         rng, srng = jax.random.split(rng)
         params, opt, m = step(params, opt, batch,
                               jnp.asarray(it, jnp.int32), srng)
