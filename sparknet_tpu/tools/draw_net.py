@@ -1,0 +1,110 @@
+"""draw_net — render a net prototxt as a Graphviz dot graph.
+
+Twin of Caffe's ``python/draw_net.py``: layers become boxes (colored by
+role), blobs become edges; in-place layers (ReLU on its own bottom)
+chain through the shared blob like Caffe's drawing does. Emits dot
+TEXT (no graphviz dependency needed to produce it; render with
+``dot -Tpng`` wherever graphviz exists).
+
+    python -m sparknet_tpu.tools.draw_net net.prototxt net.dot \
+        [--phase TRAIN|TEST|ALL]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+_ROLE_STYLE = {
+    "data": 'shape=box style=filled fillcolor="#8dd3c7"',
+    "loss": 'shape=box style=filled fillcolor="#fb8072"',
+    "learn": 'shape=box style=filled fillcolor="#80b1d3"',
+    "plain": 'shape=box style=filled fillcolor="#ffffb3"',
+}
+
+
+def _role(layer_type: str) -> str:
+    from ..nets.layers import DATA_LAYER_TYPES, LOSS_LAYER_TYPES
+
+    if layer_type in DATA_LAYER_TYPES:
+        return "data"
+    if layer_type in LOSS_LAYER_TYPES:
+        return "loss"
+    if layer_type in (
+        "Convolution", "Deconvolution", "InnerProduct", "Scale", "Bias",
+        "PReLU", "Embed", "BatchNorm", "LSTM", "RNN",
+    ):
+        return "learn"
+    return "plain"
+
+
+def _label(lp) -> str:
+    bits = [f"{lp.name}", f"({lp.type})"]
+    p = lp.sub("convolution_param") or lp.sub("inner_product_param")
+    if p is not None and p.get("num_output") is not None:
+        geom = f"out={int(p.get('num_output'))}"
+        if p.get("kernel_size") is not None:
+            geom += f" k={int(p.get('kernel_size'))}"
+        if p.get("stride") is not None:
+            geom += f" s={int(p.get('stride'))}"
+        bits.append(geom)
+    return "\\n".join(bits)
+
+
+def net_to_dot(net_param, phase: str = "ALL") -> str:
+    """NetParameter -> dot source. Blob edges respect in-place layers:
+    an edge always leaves the LAST layer that wrote the blob."""
+    layers = (
+        net_param.layers
+        if phase == "ALL"
+        else net_param.layers_for_phase(phase)
+    )
+    out = [
+        "digraph net {",
+        "  rankdir=BT;",
+        f'  label="{net_param.name or "net"}";',
+    ]
+    writer = {}  # blob -> node name of its latest producer
+    # deploy-style net-level inputs get their own nodes, so conv1 of a
+    # deploy.prototxt is not a floating root
+    for j, blob in enumerate(net_param.inputs):
+        node = f"in{j}"
+        out.append(f'  {node} [label="{blob}" {_ROLE_STYLE["data"]}];')
+        writer[blob] = node
+    for i, lp in enumerate(layers):
+        node = f"l{i}"
+        out.append(
+            f'  {node} [label="{_label(lp)}" {_ROLE_STYLE[_role(lp.type)]}];'
+        )
+        for b in lp.bottom:
+            if b not in writer:
+                # a bottom nothing produced (typo'd blob, or a phase
+                # mismatch): surface it loudly as a marked node
+                writer[b] = f"dangling_{len(writer)}"
+                out.append(
+                    f'  {writer[b]} [label="{b}??" shape=box '
+                    f'style=dashed color=red];'
+                )
+            out.append(f'  {writer[b]} -> {node} [label="{b}"];')
+        for t in lp.top:
+            writer[t] = node
+    out.append("}")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> str:
+    from ..proto import caffe_pb
+
+    ap = argparse.ArgumentParser(prog="draw_net")
+    ap.add_argument("model", help="net .prototxt")
+    ap.add_argument("out", help="output .dot path")
+    ap.add_argument("--phase", default="ALL", choices=("TRAIN", "TEST", "ALL"))
+    args = ap.parse_args(argv)
+    dot = net_to_dot(caffe_pb.load_net(args.model), phase=args.phase)
+    with open(args.out, "w") as f:
+        f.write(dot)
+    print(f"wrote {args.out} ({dot.count(chr(10))} lines)")
+    return dot
+
+
+if __name__ == "__main__":
+    main()

@@ -304,3 +304,69 @@ def test_time_net_per_layer(tmp_path):
     assert all(r["forward_ms"] > 0 for r in rows)
     conv = next(r for r in rows if r["type"] == "Convolution")
     assert conv["backward_ms"] and conv["backward_ms"] > 0
+
+
+def test_draw_net_dot_output(tmp_path):
+    from sparknet_tpu.tools import draw_net
+
+    zoo = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "sparknet_tpu", "models", "prototxt",
+    )
+    out = str(tmp_path / "net.dot")
+    dot = draw_net.main(
+        [os.path.join(zoo, "cifar10_quick_train_test.prototxt"), out,
+         "--phase", "TRAIN"]
+    )
+    assert dot.startswith("digraph net {") and dot.rstrip().endswith("}")
+    assert "conv1" in dot and "SoftmaxWithLoss" in dot
+    assert os.path.getsize(out) > 0
+    import re
+
+    # every layer bottom must have produced exactly one edge: count
+    # edges == total bottoms across drawn layers
+    from sparknet_tpu.proto import caffe_pb
+
+    npm = caffe_pb.load_net(
+        os.path.join(zoo, "cifar10_quick_train_test.prototxt")
+    )
+    n_bottoms = sum(
+        len(l.bottom) for l in npm.layers_for_phase("TRAIN")
+    )
+    edges = re.findall(r"(\w+) -> (l\d+) \[label=\"(\w+)\"\]", dot)
+    assert len(edges) == n_bottoms
+    assert not re.search(r"dangling_", dot)  # nothing unresolved
+    # in-place ReLU: the conv1 blob edge into relu1 must leave conv1's
+    # node, and the edge into conv2 must leave relu1's node (the LAST
+    # writer), proving in-place chaining
+    node_of = {
+        m.group(2): m.group(1)
+        for m in re.finditer(r'^\s*(l\d+) \[label="(\w+)', dot, re.M)
+    }
+    # cifar10_quick pools before relu: relu1 runs in place on pool1's
+    # blob, so conv2's edge must leave relu1 (the LAST writer), proving
+    # in-place chaining
+    into_relu1 = [a for a, b_, lbl in edges if b_ == node_of["relu1"]]
+    assert into_relu1 == [node_of["pool1"]]
+    into_conv2 = [a for a, b_, lbl in edges if b_ == node_of["conv2"]]
+    assert into_conv2 == [node_of["relu1"]]
+
+
+def test_draw_net_deploy_inputs_and_dangling(tmp_path):
+    """Deploy-style net-level inputs get producer nodes; a typo'd
+    bottom surfaces as a marked dangling node, not a silent drop."""
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.tools.draw_net import net_to_dot
+
+    deploy = caffe_pb.load_net("""
+name: "d"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+        convolution_param { num_output: 2 kernel_size: 3 } }
+layer { name: "oops" type: "ReLU" bottom: "typo_blob" top: "oops" }
+""", is_path=False)
+    dot = net_to_dot(deploy)
+    assert 'in0 [label="data"' in dot
+    assert "in0 -> l0" in dot  # deploy input feeds conv1
+    assert "dangling_" in dot and "typo_blob??" in dot
