@@ -261,14 +261,18 @@ class Solver:
             self.align_feed(feed)
 
     def load_weights(self, path: str) -> None:
-        """Caffe's ``--weights`` finetuning path: overlay a
-        ``.caffemodel``'s blobs (transposed to our layouts) onto the
-        initialised params/state; optimizer state is untouched."""
+        """Caffe's ``--weights`` finetuning path: overlay each listed
+        ``.caffemodel``'s blobs (comma-separated like the caffe binary;
+        later files win on overlap) onto the initialised params/state;
+        optimizer state is untouched."""
         from ..proto import caffemodel as cm
 
-        imported, st = cm.import_caffemodel(path, self.train_net)
-        p = cm.merge_into(jax.device_get(self.params), imported)
-        s = cm.merge_into(jax.device_get(self.state), st)
+        p = jax.device_get(self.params)
+        s = jax.device_get(self.state)
+        for one in path.split(","):
+            imported, st = cm.import_caffemodel(one.strip(), self.train_net)
+            p = cm.merge_into(p, imported)
+            s = cm.merge_into(s, st)
         # opt_state untouched: it may be non-addressable (multi-host
         # local mode), and finetuning starts with fresh optimizer slots
         self.params, self.state, _ = self._place_restored(p, s, {})

@@ -69,26 +69,31 @@ def build_phase_net(net_param, model_dir: str, phase: str):
 
 def load_weights(net, params, state, weights: str):
     """Overlay trained weights (.caffemodel binary NetParameter, or
-    this framework's .npz WeightCollection) onto init params/state."""
+    this framework's .npz WeightCollection) onto init params/state.
+    Comma-separated lists overlay in order with later files winning,
+    like the caffe binary's CopyTrainedLayersFrom."""
     import jax
     import jax.numpy as jnp
 
     from ..proto import caffemodel as cm
 
-    if weights.endswith(".npz"):
-        from ..nets.weights import load_npz
+    p = jax.device_get(params)
+    s = jax.device_get(state)
+    for one in weights.split(","):
+        one = one.strip()
+        if not one:
+            continue
+        if one.endswith(".npz"):
+            from ..nets.weights import load_npz
 
-        params = cm.merge_into(jax.device_get(params), load_npz(weights))
-        return jax.tree_util.tree_map(jnp.asarray, params), state
-    imported, st = cm.import_caffemodel(weights, net)
-    params = jax.tree_util.tree_map(
-        jnp.asarray, cm.merge_into(jax.device_get(params), imported)
-    )
-    if st:
-        state = jax.tree_util.tree_map(
-            jnp.asarray, cm.merge_into(jax.device_get(state), st)
-        )
-    return params, state
+            p = cm.merge_into(p, load_npz(one))
+            continue
+        imported, st = cm.import_caffemodel(one, net)
+        p = cm.merge_into(p, imported)
+        if st:
+            s = cm.merge_into(s, st)
+    to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+    return to_dev(p), to_dev(s)
 
 
 def batch_transform_fn(tf):

@@ -29,10 +29,27 @@ def _split_eq(argv: List[str]) -> List[str]:
     return out
 
 
+def _drop_gpu_flag(args: List[str]) -> List[str]:
+    """Accept-and-ignore Caffe's ``--gpu <id|all>``: device selection
+    belongs to JAX/XLA here (the visible accelerator is used), but
+    published caffe command lines must not argparse-error on it."""
+    out: List[str] = []
+    skip_value = False
+    for a in args:
+        if skip_value:
+            skip_value = False
+            continue
+        if a == "--gpu":
+            skip_value = True
+            continue
+        out.append(a)
+    return out
+
+
 def _train(argv: List[str]):
     from ..apps import cifar_app
 
-    args = _split_eq(argv)
+    args = _drop_gpu_flag(_split_eq(argv))
     # caffe spells resume as --snapshot=<state>; our apps as --restore
     args = ["--restore" if a == "--snapshot" else a for a in args]
     return cifar_app.main(args)
@@ -41,7 +58,10 @@ def _train(argv: List[str]):
 def _time(argv: List[str]):
     from . import time_net
 
-    return time_net.main(_split_eq(argv))
+    args = _drop_gpu_flag(_split_eq(argv))
+    # caffe time spells the iteration count --iterations; time_net --iters
+    args = ["--iters" if a == "--iterations" else a for a in args]
+    return time_net.main(args)
 
 
 def _test(argv: List[str]):
@@ -57,7 +77,7 @@ def _test(argv: List[str]):
     ap.add_argument("--model", required=True)
     ap.add_argument("--weights", default=None)
     ap.add_argument("--iterations", type=int, default=50)
-    args = ap.parse_args(_split_eq(argv))
+    args = ap.parse_args(_drop_gpu_flag(_split_eq(argv)))
 
     net_param = caffe_pb.load_net(args.model)
     model_dir = os.path.dirname(os.path.abspath(args.model))

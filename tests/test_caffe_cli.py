@@ -138,3 +138,22 @@ def test_caffe_grayscale_lmdb(gray_workspace):
         ["test", f"--model={gray_workspace}/net.prototxt", "--iterations=2"]
     )
     assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_caffe_cli_accepts_gpu_and_iterations_flags(workspace):
+    """Published caffe command lines (--gpu=0, time --iterations=N)
+    must run unchanged."""
+    out = caffe_cli.main(
+        ["time", f"--solver={workspace}/solver.prototxt",
+         "--iterations=2", "--gpu=0"]
+    )
+    assert out["train_step_ms"] > 0
+    result = caffe_cli.main(
+        ["train", f"--solver={workspace}/solver.prototxt", "--gpu", "all"]
+    )
+    assert "accuracy" in result
+    metrics = caffe_cli.main(
+        ["test", f"--model={workspace}/net.prototxt", "--iterations=1",
+         "--gpu=0"]
+    )
+    assert "accuracy" in metrics

@@ -333,3 +333,41 @@ layer { name: "lstm" type: "LSTM" bottom: "x" top: "lstm"
     _, blobs = caffemodel.load_caffemodel(open(out, "rb").read())
     np.testing.assert_allclose(blobs["lstm"][0], w_xc, rtol=1e-6)
     np.testing.assert_allclose(blobs["lstm"][2], w_hc, rtol=1e-6)
+
+
+def test_load_weights_comma_list(tmp_path):
+    """caffe binary semantics: --weights a.caffemodel,b.caffemodel
+    overlays in order, later files winning on overlapping layers."""
+    import jax
+
+    from sparknet_tpu.proto.caffe_pb import SolverParameter
+    from sparknet_tpu.solver.trainer import Solver
+
+    net_txt = """
+name: "two"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ipA" type: "InnerProduct" bottom: "data" top: "ipA"
+        inner_product_param { num_output: 3
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "ipB" type: "InnerProduct" bottom: "ipA" top: "ipB"
+        inner_product_param { num_output: 2
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ipB" bottom: "label" top: "loss" }
+"""
+    sp = SolverParameter(base_lr=0.1, lr_policy="fixed", max_iter=1)
+    sp.net_param = caffe_pb.load_net(net_txt, is_path=False)
+    solver = Solver(sp, {"data": (2, 4), "label": (2,)})
+    from sparknet_tpu.proto import caffemodel as cm
+
+    # file 1: sets both layers; file 2: overrides only ipB
+    p1 = {"ipA": {"weight": np.full((4, 3), 1.0, np.float32)},
+          "ipB": {"weight": np.full((3, 2), 2.0, np.float32)}}
+    p2 = {"ipB": {"weight": np.full((3, 2), 9.0, np.float32)}}
+    f1, f2 = str(tmp_path / "a.caffemodel"), str(tmp_path / "b.caffemodel")
+    cm.export_caffemodel(f1, solver.train_net, p1)
+    cm.export_caffemodel(f2, solver.train_net, p2)
+    solver.load_weights(f"{f1},{f2}")
+    got = jax.device_get(solver.params)
+    np.testing.assert_allclose(got["ipA"]["weight"], 1.0)
+    np.testing.assert_allclose(got["ipB"]["weight"], 9.0)  # later wins
