@@ -58,7 +58,9 @@ def make_args(**overrides) -> argparse.Namespace:
     return args
 
 
-def build(args):
+def make_config(args):
+    """(BertConfig, seq_len) from the CLI flags — shared by the Solver
+    path and the model-parallel modes so config knobs cannot drift."""
     import dataclasses
 
     cfg = CONFIGS[args.config]()
@@ -86,6 +88,16 @@ def build(args):
         raise ValueError(
             f"--seq-len {seq} exceeds max_position {cfg.max_position}; "
             f"raise --max-position"
+        )
+    return cfg, seq
+
+
+def build(args):
+    cfg, seq = make_config(args)
+    if args.attention in ("ring", "ulysses"):
+        raise ValueError(
+            f"--attention {args.attention} is a sequence-parallel "
+            f"implementation: use --parallel sp (or tp with an sp mesh axis)"
         )
     bs = args.batch_size
     max_preds = max(1, int(seq * 0.15) + 1)
@@ -133,6 +145,157 @@ def build(args):
     return solver, feed, cfg
 
 
+def parse_mesh(spec: str, default_axis: str):
+    """"dp=2,tp=2,sp=2" -> axis dict (one size may be -1); empty spec
+    puts every device on ``default_axis`` with a unit dp axis (the step
+    factories always reduce over dp)."""
+    if not spec:
+        return {"dp": 1, default_axis: -1}
+    axes = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    if "dp" not in axes:
+        raise ValueError(
+            f"--mesh {spec!r}: include a dp axis (dp=1 for none) — the "
+            f"parallel train steps reduce gradients over dp"
+        )
+    return axes
+
+
+def run_model_parallel(args) -> Dict[str, float]:
+    """The tp/sp/pp/ep modes: token-level MLM loss over an explicit
+    mesh, driven by the parallel step factories (the same ones the
+    driver's multi-chip dryrun exercises) rather than the Solver class.
+
+        bert_app --parallel sp --mesh dp=2,sp=4 --attention ring
+        bert_app --parallel tp --mesh dp=2,tp=2,sp=2
+        bert_app --parallel pp --mesh dp=2,pp=4 --pp-microbatches 2
+        bert_app --parallel ep --mesh dp=2,ep=4 --moe-experts 4
+    """
+    import dataclasses
+
+    from ..data.text import mlm_dataset, mlm_feed_tokens
+    from ..nets import weights as W
+    from ..parallel.mesh import make_mesh
+    from ..solver.caffe_solver import init_opt_state
+    from ..utils.profiling import StepTimer
+
+    mode = args.parallel
+    if args.restore or args.auto_resume:
+        raise ValueError(
+            f"--restore/--auto-resume are Solver-path features; the "
+            f"{mode} mode snapshots params only (no solver state yet)"
+        )
+    cfg, seq = make_config(args)
+    bs = args.batch_size
+    axes = parse_mesh(args.mesh, mode)
+    # a fully-specified spec smaller than the device count uses a
+    # prefix of the devices (e.g. dp=2,pp=2 on an 8-device host)
+    sizes = list(axes.values())
+    devices = None
+    if -1 not in sizes:
+        total = int(np.prod(sizes))
+        devices = jax.devices()[:total]
+    mesh = make_mesh(axes, devices)
+    ds, vs = mlm_dataset(
+        text_files=args.text_files or None, vocab_size=cfg.vocab_size,
+        n_tokens=args.synthetic_tokens, seq_len=seq, seed=args.seed,
+    )
+    if vs != cfg.vocab_size:  # corpus-built vocab may be smaller
+        cfg = dataclasses.replace(cfg, vocab_size=vs)
+    shapes = {"input_ids": (bs, seq), "mlm_positions": (bs, 8)}
+    sp_param = make_solver_param(args)
+    cdt = jnp.bfloat16 if args.bf16 else jnp.float32
+
+    if mode == "sp":
+        from ..parallel.sequence import make_sp_train_step
+
+        impl = args.attention or "ring"
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"--parallel sp needs --attention ring|ulysses "
+                f"(got {impl!r}); flash/reference cannot shard the "
+                f"sequence axis"
+            )
+        model = BertMLM(cfg, shapes, compute_dtype=cdt,
+                        attention_impl=impl, sp_axis="sp")
+        step = make_sp_train_step(model, sp_param, mesh)
+    elif mode == "tp":
+        from ..parallel.tensor import make_tp_train_step
+
+        has_sp = "sp" in mesh.shape
+        model = BertMLM(
+            cfg, shapes, compute_dtype=cdt, tp_axis="tp",
+            attention_impl="ring" if has_sp else None,
+            sp_axis="sp" if has_sp else None,
+        )
+        step = make_tp_train_step(
+            model, sp_param, mesh, dp_axis="dp", tp_axis="tp",
+            sp_axis="sp" if has_sp else None,
+        )
+    elif mode == "pp":
+        from ..parallel.pipeline import make_pp_train_step, stack_layer_params
+
+        model = BertMLM(cfg, shapes, compute_dtype=cdt)
+        step = make_pp_train_step(
+            model, sp_param, mesh, n_micro=args.pp_microbatches,
+            dp_axis="dp",
+        )
+    elif mode == "ep":
+        from ..parallel.expert import make_ep_train_step
+
+        if not cfg.moe_num_experts:
+            raise ValueError("--parallel ep needs --moe-experts N")
+        model = BertMLM(cfg, shapes, compute_dtype=cdt, ep_axis="ep")
+        step = make_ep_train_step(model, sp_param, mesh, dp_axis="dp",
+                                  ep_axis="ep")
+    else:  # pragma: no cover — guarded by argparse choices
+        raise ValueError(mode)
+
+    params, _ = model.init(jax.random.PRNGKey(args.seed))
+    if mode == "pp":
+        from ..parallel.pipeline import stack_layer_params
+
+        stacked, rest = stack_layer_params(params, cfg.num_layers)
+        params = {"layers": stacked, "rest": rest}
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    print(
+        f"BertApp[{mode}]: mesh={dict(mesh.shape)} vocab={cfg.vocab_size} "
+        f"layers={cfg.num_layers} hidden={cfg.hidden_size} params={n_params}"
+    )
+    opt_state = init_opt_state(sp_param, params)
+    feed = mlm_feed_tokens(ds, bs, vs, seed=args.seed)
+    timer = StepTimer(items_per_step=bs * seq, unit="tokens")
+    rng = jax.random.PRNGKey(args.seed + 1)
+    metrics: Dict[str, float] = {}
+    display = args.display or 20
+    last_report = 0
+    for it in range(args.max_iter):
+        batch = {k: jnp.asarray(v) for k, v in next(feed).items()}
+        rng, srng = jax.random.split(rng)
+        params, opt_state, m = step(
+            params, opt_state, batch, jnp.asarray(it, jnp.int32), srng
+        )
+        done = it + 1
+        if done % display == 0 or done == args.max_iter:
+            metrics = {k: float(v) for k, v in m.items()}
+            jax.block_until_ready(next(iter(m.values())))
+            timer.update(done - last_report)  # honest partial windows
+            last_report = done
+            print(
+                f"Iteration {done}, "
+                + ", ".join(f"{k} = {v:.5f}" for k, v in metrics.items())
+            )
+            print(f"    speed: {timer.format()}")
+        if args.snapshot and (done % args.snapshot == 0
+                              or done == args.max_iter):
+            path = f"{args.snapshot_prefix}_{mode}_iter_{done}.npz"
+            W.save_npz(path, jax.device_get(params))
+            print(f"Snapshotting params to {path}")
+    return metrics
+
+
 def parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description="BERT MLM pre-training (BertApp)")
     ap.add_argument("--config", choices=sorted(CONFIGS), default="base")
@@ -148,11 +311,24 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--display", type=int, default=20)
     ap.add_argument("--text-files", nargs="*", default=None)
     ap.add_argument("--synthetic-tokens", type=int, default=1 << 16)
-    ap.add_argument("--parallel", choices=("none", "sync", "local"),
-                    default="none")
+    ap.add_argument("--parallel",
+                    choices=("none", "sync", "local", "tp", "sp", "pp", "ep"),
+                    default="none",
+                    help="none/sync/local drive the Solver; tp/sp/pp/ep "
+                         "run the model-parallel token-loss steps over "
+                         "--mesh")
+    ap.add_argument("--mesh", default="",
+                    help="axis spec for tp/sp/pp/ep, e.g. dp=2,tp=2,sp=2 "
+                         "(one size may be -1 = all remaining devices)")
+    ap.add_argument("--pp-microbatches", type=int, default=2)
     ap.add_argument("--tau", type=int, default=10)
     ap.add_argument("--bf16", action="store_true")
-    ap.add_argument("--attention", choices=("flash", "reference"), default=None)
+    ap.add_argument("--attention",
+                    choices=("flash", "reference", "ring", "ulysses"),
+                    default=None,
+                    help="flash/reference pick the single-device kernel; "
+                         "ring/ulysses are the --parallel sp "
+                         "implementations")
     ap.add_argument("--moe-experts", type=int, default=0,
                     help="replace dense FFNs with an N-expert MoE")
     ap.add_argument("--moe-top-k", type=int, default=1)
@@ -166,7 +342,9 @@ def parser() -> argparse.ArgumentParser:
                     help="rematerialise encoder layers (activation "
                          "memory ~ O(1) in depth; long-context knob)")
     ap.add_argument("--snapshot", type=int, default=0,
-                    help="snapshot solver state every N iters")
+                    help="snapshot every N iters (Solver modes: full "
+                         "solver state, resumable; tp/sp/pp/ep modes: "
+                         "params-only npz)")
     ap.add_argument("--snapshot-prefix", default="bert")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
@@ -184,6 +362,8 @@ def parser() -> argparse.ArgumentParser:
 def main(argv=None) -> Dict[str, float]:
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
+    if args.parallel in ("tp", "sp", "pp", "ep"):
+        return run_model_parallel(args)
     solver, feed, cfg = build(args)
     from ..solver.snapshot import apply_auto_resume
 

@@ -195,3 +195,27 @@ def test_bert_app_long_context_max_position():
         bert_app.build(
             bert_app.make_args(config="tiny", seq_len=512, batch_size=2)
         )
+
+
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("sp", ["--mesh", "dp=2,sp=4"]),
+        ("tp", ["--mesh", "dp=2,tp=2,sp=2"]),
+        ("pp", ["--mesh", "dp=2,pp=2", "--pp-microbatches", "2"]),
+        ("ep", ["--mesh", "dp=2,ep=4", "--moe-experts", "4"]),
+    ],
+)
+def test_bert_app_model_parallel_modes(mode, extra):
+    """Every model-parallel axis is reachable from the app CLI (the
+    same step factories the driver dryrun exercises)."""
+    from sparknet_tpu.apps import bert_app
+
+    metrics = bert_app.main(
+        [
+            "--config", "tiny", "--parallel", mode, "--batch-size", "4",
+            "--seq-len", "64", "--max-iter", "2", "--display", "2",
+        ]
+        + extra
+    )
+    assert np.isfinite(metrics["loss"])
