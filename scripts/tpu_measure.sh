@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement sweep: run when the axon tunnel is alive to
+# capture every benchmark in a single window (the tunnel has died for
+# hours at a time mid-round — see RESULTS.md). Appends JSON lines and
+# tables to the log; safe to re-run, each section is independent and a
+# section that fails or finds the tunnel dead leaves an explicit
+# FAILED/TUNNEL-DEAD marker instead of a silent gap.
+#
+#   bash scripts/tpu_measure.sh [logfile]            # default tpu_measure.log
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-tpu_measure.log}"
+
+probe() {
+  timeout 45 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+say() { echo "== $* ==" | tee -a "$LOG"; }
+
+# run_logged <label> <cmd...>: append the command's last stdout line,
+# or an explicit failure marker (stderr goes to $LOG.err for debugging)
+run_logged() {
+  local label="$1"; shift
+  if ! probe; then
+    echo "TUNNEL-DEAD before $label" | tee -a "$LOG"
+    return 1
+  fi
+  local out
+  out="$("$@" 2>>"$LOG.err" | tail -1)"
+  local rc=$?
+  if [ $rc -ne 0 ] || [ -z "$out" ]; then
+    echo "FAILED($label) rc=$rc — see $LOG.err" | tee -a "$LOG"
+    return 1
+  fi
+  echo "$out" | tee -a "$LOG"
+}
+
+if ! probe; then
+  echo "tunnel dead; aborting (nothing written)" >&2
+  exit 1
+fi
+echo "# tpu_measure $(date -u +%FT%TZ)" >> "$LOG"
+
+say "bench: imagenet archs (compute-only)"
+for arch in alexnet googlenet resnet50 vgg16; do
+  BENCH_MODEL=$arch run_logged "bench-$arch" timeout 600 python bench.py
+done
+
+say "bench: bert (flash+fused-qkv default, analytic MFU)"
+BENCH_MODEL=bert run_logged "bench-bert" timeout 600 python bench.py
+
+say "bench: alexnet end-to-end input pipeline (python + native, prefetched)"
+BENCH_INPUT_PIPELINE=1 run_logged "e2e-python" timeout 600 python bench.py
+BENCH_INPUT_PIPELINE=native run_logged "e2e-native" timeout 600 python bench.py
+
+say "per-layer alexnet table (the MFU diagnosis)"
+if probe; then
+  timeout 600 python -m sparknet_tpu.tools.time_net \
+    --solver sparknet_tpu/models/prototxt/bvlc_alexnet_solver.prototxt \
+    --batch-size 256 --iters 10 --bf16 --per-layer \
+    2>>"$LOG.err" | tee -a "$LOG" \
+    || echo "FAILED(per-layer) — see $LOG.err" | tee -a "$LOG"
+else
+  echo "TUNNEL-DEAD before per-layer" | tee -a "$LOG"
+fi
+
+say "flash dropout keep-rate (hardware-gated regression test)"
+if probe; then
+  SPARKNET_TEST_TPU=1 timeout 600 python -m pytest \
+    "tests/test_attention.py::test_flash_dropout_keep_rate_on_hardware" \
+    -q -p no:cacheprovider 2>&1 | tail -2 | tee -a "$LOG"
+else
+  echo "TUNNEL-DEAD before dropout test" | tee -a "$LOG"
+fi
+
+say "done ($(date -u +%FT%TZ))"

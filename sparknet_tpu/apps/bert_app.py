@@ -182,6 +182,12 @@ def run_model_parallel(args) -> Dict[str, float]:
     from ..utils.profiling import StepTimer
 
     mode = args.parallel
+    if jax.process_count() > 1:
+        raise ValueError(
+            f"--parallel {mode} is single-process (one controller over "
+            f"the local mesh); multi-host launches use --parallel "
+            f"sync|local"
+        )
     if args.restore or args.auto_resume:
         raise ValueError(
             f"--restore/--auto-resume are Solver-path features; the "
@@ -255,8 +261,6 @@ def run_model_parallel(args) -> Dict[str, float]:
 
     params, _ = model.init(jax.random.PRNGKey(args.seed))
     if mode == "pp":
-        from ..parallel.pipeline import stack_layer_params
-
         stacked, rest = stack_layer_params(params, cfg.num_layers)
         params = {"layers": stacked, "rest": rest}
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
@@ -269,7 +273,7 @@ def run_model_parallel(args) -> Dict[str, float]:
     timer = StepTimer(items_per_step=bs * seq, unit="tokens")
     rng = jax.random.PRNGKey(args.seed + 1)
     metrics: Dict[str, float] = {}
-    display = args.display or 20
+    display = args.display  # 0 = silent, like the Solver path
     last_report = 0
     for it in range(args.max_iter):
         batch = {k: jnp.asarray(v) for k, v in next(feed).items()}
@@ -278,20 +282,27 @@ def run_model_parallel(args) -> Dict[str, float]:
             params, opt_state, batch, jnp.asarray(it, jnp.int32), srng
         )
         done = it + 1
-        if done % display == 0 or done == args.max_iter:
-            metrics = {k: float(v) for k, v in m.items()}
-            jax.block_until_ready(next(iter(m.values())))
-            timer.update(done - last_report)  # honest partial windows
-            last_report = done
-            print(
-                f"Iteration {done}, "
-                + ", ".join(f"{k} = {v:.5f}" for k, v in metrics.items())
-            )
-            print(f"    speed: {timer.format()}")
+        if done == args.max_iter or (display and done % display == 0):
+            metrics = {k: float(v) for k, v in m.items()}  # host sync
+            if display:
+                timer.update(done - last_report)  # honest partial windows
+                last_report = done
+                print(
+                    f"Iteration {done}, "
+                    + ", ".join(
+                        f"{k} = {v:.5f}" for k, v in metrics.items()
+                    )
+                )
+                print(f"    speed: {timer.format()}")
         if args.snapshot and (done % args.snapshot == 0
                               or done == args.max_iter):
             path = f"{args.snapshot_prefix}_{mode}_iter_{done}.npz"
-            W.save_npz(path, jax.device_get(params))
+            # pp params nest three deep ({layers, rest{layer{name}}});
+            # save a two-level view load_npz can round-trip
+            tree = jax.device_get(params)
+            if mode == "pp":
+                tree = {**tree["rest"], "pp_stacked_layers": tree["layers"]}
+            W.save_npz(path, tree)
             print(f"Snapshotting params to {path}")
     return metrics
 

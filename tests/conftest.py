@@ -6,16 +6,22 @@ driver dry-runs the multi-chip path. Note: this environment pins
 ``JAX_PLATFORMS=axon`` (the TPU tunnel) and re-asserts it over the env
 var, so we must force CPU through ``jax.config`` — the env var alone is
 not honored.
+
+``SPARKNET_TEST_TPU=1`` keeps the real backend instead, for the
+hardware-gated tests (scripts/tpu_measure.sh runs them that way).
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("SPARKNET_TEST_TPU", "") not in ("", "0"):
+    pass  # real accelerator: leave the backend alone
+else:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-import jax
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
