@@ -7,7 +7,7 @@
 # FAILED/TUNNEL-DEAD marker instead of a silent gap.
 #
 #   bash scripts/tpu_measure.sh [logfile]            # default tpu_measure.log
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 LOG="${1:-tpu_measure.log}"
 
@@ -25,9 +25,12 @@ run_logged() {
     echo "TUNNEL-DEAD before $label" | tee -a "$LOG"
     return 1
   fi
-  local out
-  out="$("$@" 2>>"$LOG.err" | tail -1)"
-  local rc=$?
+  # capture rc of the COMMAND, not the pipe tail: run it alone, then
+  # trim (pipefail is set, but this keeps the rc/output split explicit)
+  local out rc
+  out="$("$@" 2>>"$LOG.err")"
+  rc=$?
+  out="$(printf '%s\n' "$out" | tail -1)"
   if [ $rc -ne 0 ] || [ -z "$out" ]; then
     echo "FAILED($label) rc=$rc — see $LOG.err" | tee -a "$LOG"
     return 1
@@ -55,11 +58,13 @@ BENCH_INPUT_PIPELINE=native run_logged "e2e-native" timeout 600 python bench.py
 
 say "per-layer alexnet table (the MFU diagnosis)"
 if probe; then
-  timeout 600 python -m sparknet_tpu.tools.time_net \
-    --solver sparknet_tpu/models/prototxt/bvlc_alexnet_solver.prototxt \
-    --batch-size 256 --iters 10 --bf16 --per-layer \
-    2>>"$LOG.err" | tee -a "$LOG" \
-    || echo "FAILED(per-layer) — see $LOG.err" | tee -a "$LOG"
+  if ! timeout 600 python -m sparknet_tpu.tools.time_net \
+      --solver sparknet_tpu/models/prototxt/bvlc_alexnet_solver.prototxt \
+      --batch-size 256 --iters 10 --bf16 --per-layer \
+      2>>"$LOG.err" | tee -a "$LOG"; then
+    # pipefail: a python failure (not tee's) lands here
+    echo "FAILED(per-layer) — see $LOG.err" | tee -a "$LOG"
+  fi
 else
   echo "TUNNEL-DEAD before per-layer" | tee -a "$LOG"
 fi

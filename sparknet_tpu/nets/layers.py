@@ -321,6 +321,85 @@ class Pooling:
         raise NotImplementedError(f"pool mode {mode}")
 
 
+class SPP:
+    """Spatial pyramid pooling (He et al.): pyramid level i pools into
+    a 2^i x 2^i grid (Caffe geometry: kernel = ceil(dim/bins), pad
+    centers the remainder), each level flattens in NCHW order and the
+    levels concatenate — a fixed-length descriptor from any input
+    resolution."""
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.sub("spp_param")
+        if p is None or p.get("pyramid_height") is None:
+            raise ValueError(
+                f"layer {lp.name!r}: SPP requires "
+                f"spp_param {{ pyramid_height: N }}"
+            )
+        return int(p.get("pyramid_height")), str(p.get("pool", "MAX"))
+
+    @staticmethod
+    def _level(dim: int, bins: int):
+        k = -(-dim // bins)  # ceil
+        remainder = k * bins - dim
+        pad = (remainder + 1) // 2
+        return k, pad
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        height, _ = SPP._geom(lp)
+        n, h, w, c = in_shapes[0]
+        top_bins = 2 ** (height - 1)
+        if top_bins > min(h, w):
+            # Caffe CHECKs this at setup; without it the padded MAX
+            # windows cover only -inf and the loss goes NaN silently
+            raise ValueError(
+                f"layer {lp.name!r}: pyramid level {height - 1} needs "
+                f"{top_bins} bins per side but the input is {h}x{w}"
+            )
+        total = sum((2 ** i) ** 2 for i in range(height))
+        return [(n, c * total)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        height, mode = SPP._geom(lp)
+        x = inputs[0]
+        n, h, w, c = x.shape
+        SPP.infer(lp, [x.shape])  # re-check bins vs dims (direct callers)
+        pieces = []
+        for i in range(height):
+            bins = 2 ** i
+            kh, ph = SPP._level(h, bins)
+            kw, pw = SPP._level(w, bins)
+            if mode == "MAX":
+                init_v = -jnp.inf
+                op = lax.max
+            elif mode == "AVE":
+                init_v = 0.0
+                op = lax.add
+            else:
+                raise NotImplementedError(f"spp pool mode {mode}")
+            y = lax.reduce_window(
+                x.astype(jnp.float32), init_v, op,
+                window_dimensions=(1, kh, kw, 1),
+                window_strides=(1, kh, kw, 1),
+                padding=((0, 0), (ph, kh * bins - h - ph),
+                         (pw, kw * bins - w - pw), (0, 0)),
+            )
+            if mode == "AVE":
+                y = y / (kh * kw)  # Caffe divides by the full window
+            # flatten in NCHW order so the descriptor layout matches
+            pieces.append(
+                jnp.transpose(y, (0, 3, 1, 2)).reshape(n, -1)
+            )
+        out = jnp.concatenate(pieces, axis=1)
+        return [out.astype(x.dtype)], None
+
+
 class InnerProduct:
     @staticmethod
     def _geom(lp):
@@ -1561,4 +1640,5 @@ LAYER_IMPLS = {
     "InfogainLoss": InfogainLoss,
     "LSTM": LSTM,
     "RNN": RNN,
+    "SPP": SPP,
 }

@@ -617,3 +617,43 @@ layer { name: "loss" type: "EuclideanLoss" bottom: "lstm" bottom: "target" top: 
     first = float(solver.step(feed(), 1)["loss"])
     last = float(solver.step(feed(), 19)["loss"])
     assert np.isfinite(last) and last < first  # it learns the mapping
+
+
+def test_spp_vs_torch_adaptive_and_shapes():
+    """SPP level geometry vs torch max_pool2d with explicit ceil-kernel
+    windows; fixed-length output from two different input sizes."""
+    rng = np.random.default_rng(30)
+    lp = lp_from('name: "s" type: "SPP" spp_param { pyramid_height: 3 }')
+    for h, w in ((13, 13), (9, 11)):
+        x = rng.normal(size=(2, 4, h, w)).astype(np.float32)
+        assert L.SPP.infer(lp, [(2, h, w, 4)]) == [(2, 4 * (1 + 4 + 16))]
+        (y,), _ = L.SPP.apply(lp, {}, None, [nhwc(x)], CTX)
+        assert y.shape == (2, 84)
+        # level 0 (1x1 bin) is a global max over each channel map
+        np.testing.assert_allclose(
+            np.asarray(y)[:, :4], x.max((2, 3)), rtol=1e-6
+        )
+        # level 1 (2x2) matches torch pooling with the same ceil
+        # kernel and centered padding
+        bins = 2
+        kh, ph = L.SPP._level(h, bins)
+        kw, pw = L.SPP._level(w, bins)
+        ref = torch.nn.functional.max_pool2d(
+            torch.nn.functional.pad(
+                torch.from_numpy(x),
+                (pw, kw * bins - w - pw, ph, kh * bins - h - ph),
+                value=float("-inf"),
+            ),
+            (kh, kw), (kh, kw),
+        ).numpy()
+        np.testing.assert_allclose(
+            np.asarray(y)[:, 4:20], ref.reshape(2, -1), rtol=1e-6
+        )
+
+
+def test_spp_rejects_too_deep_pyramid_and_missing_param():
+    lp = lp_from('name: "s" type: "SPP" spp_param { pyramid_height: 4 }')
+    with pytest.raises(ValueError, match="bins per side"):
+        L.SPP.infer(lp, [(1, 7, 7, 2)])  # level 3 wants 8 bins on 7px
+    with pytest.raises(ValueError, match="pyramid_height"):
+        L.SPP.infer(lp_from('name: "s" type: "SPP"'), [(1, 8, 8, 2)])
