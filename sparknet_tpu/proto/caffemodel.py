@@ -22,7 +22,7 @@ dynamic messages in tests/test_caffemodel.py.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 

@@ -7,8 +7,6 @@ policy, and weight merging cannot drift between tools."""
 
 from __future__ import annotations
 
-import os
-from typing import Optional, Tuple
 
 DATA_SOURCE_TYPES = ("Data", "ImageData", "HDF5Data")
 
