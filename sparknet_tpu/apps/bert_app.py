@@ -366,6 +366,9 @@ def parser() -> argparse.ArgumentParser:
                     help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="batches staged ahead on device (0 disables)")
+    ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
+                    default="npz",
+                    help="solverstate on-disk format (Solver modes)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -376,6 +379,9 @@ def main(argv=None) -> Dict[str, float]:
     if args.parallel in ("tp", "sp", "pp", "ep"):
         return run_model_parallel(args)
     solver, feed, cfg = build(args)
+    from ..solver.snapshot import solverstate_suffix
+
+    solver.snapshot_suffix = solverstate_suffix(args.snapshot_format)
     from ..solver.snapshot import apply_auto_resume
 
     apply_auto_resume(args, args.snapshot_prefix)
@@ -438,7 +444,10 @@ def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
             print(f"    speed: {timer.update(solver.iter - prev_iter).format()}")
         at_end = solver.iter >= args.max_iter
         if args.snapshot and (solver.iter % args.snapshot == 0 or at_end):
-            path = f"{args.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
+            path = (
+                f"{args.snapshot_prefix}_iter_{solver.iter}"
+                f"{solver.snapshot_suffix}"
+            )
             solver.save(path)  # collective; process 0 writes
             if primary:
                 print(f"Snapshotting solver state to {path}")

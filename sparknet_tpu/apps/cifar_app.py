@@ -309,7 +309,10 @@ def train_loop(
             and (solver.iter % sp.snapshot == 0 or at_end)
         ):
             path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
-            state_path = f"{sp.snapshot_prefix}_iter_{solver.iter}.solverstate.npz"
+            state_path = (
+                f"{sp.snapshot_prefix}_iter_{solver.iter}"
+                f"{solver.snapshot_suffix}"
+            )
             # collective (gathers host-sharded optimizer slots); every
             # process participates, only process 0 writes the files
             solver.save(state_path)
@@ -360,6 +363,10 @@ def arg_parser() -> argparse.ArgumentParser:
                     help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="batches staged ahead on device (0 disables)")
+    ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
+                    default="npz",
+                    help="solverstate on-disk format (orbax writes "
+                         "sharded device arrays directly)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -371,6 +378,9 @@ def main(argv=None):
 
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
+    from ..solver.snapshot import solverstate_suffix
+
+    solver.snapshot_suffix = solverstate_suffix(args.snapshot_format)
     from ..solver.snapshot import apply_auto_resume
 
     apply_auto_resume(args, solver.sp.snapshot_prefix)

@@ -197,6 +197,9 @@ def parser() -> argparse.ArgumentParser:
                     help="dump a jax.profiler trace of the training loop")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="batches staged ahead on device (0 disables)")
+    ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
+                    default="npz",
+                    help="solverstate on-disk format")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -205,6 +208,9 @@ def main(argv=None):
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
+    from ..solver.snapshot import solverstate_suffix
+
+    solver.snapshot_suffix = solverstate_suffix(args.snapshot_format)
     from ..solver.snapshot import apply_auto_resume
 
     apply_auto_resume(args, solver.sp.snapshot_prefix)

@@ -8,6 +8,15 @@ Our ``.solverstate.npz`` holds params, net state (e.g. BatchNorm
 statistics), every optimizer slot, the iteration counter and the
 solver's PRNG key; the pytree structure rides along as one JSON entry,
 so restore needs no model to reconstruct shapes.
+
+Two on-disk formats, selected by the path suffix:
+
+- ``….solverstate.npz`` — one self-contained npz file (default; easy
+  to ship and inspect).
+- ``….solverstate.orbax`` — an Orbax checkpoint directory
+  (``--snapshot-format orbax``): the TPU-ecosystem format, which
+  writes sharded device arrays directly (no host gather) and scales to
+  model sizes where a single npz is impractical.
 """
 
 from __future__ import annotations
@@ -22,6 +31,30 @@ import numpy as np
 
 FORMAT_VERSION = 1
 _META_KEY = "__solverstate__"
+
+NPZ_SUFFIX = ".solverstate.npz"
+ORBAX_SUFFIX = ".solverstate.orbax"
+
+
+def solverstate_suffix(fmt: str) -> str:
+    """CLI ``--snapshot-format`` value -> path suffix."""
+    try:
+        return {"npz": NPZ_SUFFIX, "orbax": ORBAX_SUFFIX}[fmt]
+    except KeyError:
+        raise ValueError(f"snapshot format {fmt!r}: want npz|orbax")
+
+
+def _require_orbax():
+    """Import orbax.checkpoint with an actionable error: failing at
+    snapshot time mid-run must say HOW to fix it, not just crash."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise ImportError(
+            "--snapshot-format orbax needs the 'orbax-checkpoint' "
+            "package (pip install sparknet_tpu[orbax])"
+        ) from e
+    return ocp
 
 
 def _to_host(x: Any, materialize: bool = True) -> np.ndarray:
@@ -83,12 +116,30 @@ def _decode(spec: Any, leaves: Dict[str, np.ndarray]) -> Any:
 
 def save_state(path: str, **trees: Any) -> None:
     """Write named pytrees (nested dict/list/tuple of arrays and Python
-    scalars) to one npz. Device arrays are pulled to host — with a
+    scalars) to one npz — or to an Orbax checkpoint when ``path`` ends
+    with the orbax suffix. Device arrays are pulled to host — with a
     cross-host gather for non-addressable leaves, so in multi-host mode
-    this must run on EVERY process; only process 0 touches the disk.
-    The write is atomic (tmp + rename) so a preemption mid-snapshot can
-    never leave a truncated file for auto-resume to trip over."""
+    this must run on EVERY process; only process 0 touches the disk
+    (orbax coordinates its own distributed write). The npz write is
+    atomic (tmp + rename) so a preemption mid-snapshot can never leave
+    a truncated file for auto-resume to trip over; orbax writes to a
+    tmp dir and renames, giving the same guarantee."""
     import jax
+
+    if path.endswith(ORBAX_SUFFIX):
+        # Orbax's Checkpointer commits atomically itself (tmp dir +
+        # rename, coordinated across processes) — no manual staging,
+        # which would race between hosts on shared storage. NOTE: orbax
+        # canonicalizes tuples to lists on restore; Solver state is all
+        # dicts, so the contract holds where it matters.
+        ocp = _require_orbax()
+        target = os.path.abspath(path)
+        ocp.PyTreeCheckpointer().save(
+            target,
+            {"__solverstate_version__": FORMAT_VERSION, "trees": dict(trees)},
+            force=True,  # overwrite a previous snapshot at this path
+        )
+        return
 
     primary = jax.process_index() == 0
     leaves: list = []
@@ -118,11 +169,12 @@ def latest_solverstate(prefix: str) -> Optional[str]:
     snapshots; SURVEY.md §5 elasticity)."""
     best: Optional[str] = None
     best_iter = -1
-    for path in glob.glob(f"{prefix}_iter_*.solverstate.npz"):
-        m = re.search(r"_iter_(\d+)\.solverstate\.npz$", path)
-        if m and int(m.group(1)) > best_iter:
-            best_iter = int(m.group(1))
-            best = path
+    for suffix in (NPZ_SUFFIX, ORBAX_SUFFIX):
+        for path in glob.glob(f"{prefix}_iter_*{suffix}"):
+            m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path)
+            if m and int(m.group(1)) > best_iter:
+                best_iter = int(m.group(1))
+                best = path
     return best
 
 
@@ -141,15 +193,23 @@ def resolve_auto_resume(prefix: str, explicit: Optional[str]) -> Optional[str]:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        it = -1
+        # broadcast (iter, is_orbax) so every process rebuilds the same
+        # path regardless of its own directory listing
+        it, fmt = -1, 0
         if path:
-            it = int(
-                re.search(r"_iter_(\d+)\.solverstate\.npz$", path).group(1)
+            m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path)
+            it = int(m.group(1))
+            fmt = 1 if m.group(2) == "orbax" else 0
+        it, fmt = (
+            int(x)
+            for x in multihost_utils.broadcast_one_to_all(
+                np.asarray([it, fmt])
             )
-        it = int(multihost_utils.broadcast_one_to_all(np.asarray(it)))
+        )
         if it < 0:
             return None
-        cand = f"{prefix}_iter_{it}.solverstate.npz"
+        suffix = ORBAX_SUFFIX if fmt else NPZ_SUFFIX
+        cand = f"{prefix}_iter_{it}{suffix}"
         if not os.path.exists(cand):
             raise FileNotFoundError(
                 f"process {jax.process_index()} cannot see {cand}; "
@@ -169,6 +229,17 @@ def apply_auto_resume(args, prefix: str) -> None:
 
 def load_state(path: str) -> Dict[str, Any]:
     """Inverse of :func:`save_state`; leaves come back as host numpy."""
+    if path.endswith(ORBAX_SUFFIX):
+        import jax
+
+        ocp = _require_orbax()
+        got = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        version = int(np.asarray(got.get("__solverstate_version__", -1)))
+        if version != FORMAT_VERSION:
+            raise ValueError(
+                f"solverstate version {version} != {FORMAT_VERSION}"
+            )
+        return jax.tree_util.tree_map(np.asarray, got["trees"])
     with np.load(path) as z:
         meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
         if meta["version"] != FORMAT_VERSION:

@@ -171,6 +171,10 @@ class Solver:
         self.params, self.state = self.train_net.init(init_rng)
         self.opt_state = init_opt_state(solver, self.params)
         self.iter = 0
+        # solverstate on-disk format; apps override from --snapshot-format
+        from .snapshot import NPZ_SUFFIX
+
+        self.snapshot_suffix = NPZ_SUFFIX
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         self._train_step = jax.jit(
