@@ -193,6 +193,11 @@ def run_model_parallel(args) -> Dict[str, float]:
             f"--restore/--auto-resume are Solver-path features; the "
             f"{mode} mode snapshots params only (no solver state yet)"
         )
+    if args.snapshot_format != "npz":
+        raise ValueError(
+            f"--snapshot-format {args.snapshot_format} is a Solver-path "
+            f"feature; the {mode} mode snapshots params-only .npz"
+        )
     cfg, seq = make_config(args)
     bs = args.batch_size
     axes = parse_mesh(args.mesh, mode)

@@ -27,6 +27,18 @@ import jax
 _SENTINEL = object()
 
 
+def _put_checked(q, stop, item) -> None:
+    """Bounded put that gives up once the consumer signals stop, so the
+    worker thread can always exit instead of blocking forever on a full
+    queue holding staged device batches."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return
+        except queue.Full:
+            continue
+
+
 def prefetch_to_device(
     it: Iterator[Any],
     size: int = 2,
@@ -51,18 +63,13 @@ def prefetch_to_device(
         try:
             for b in it:
                 staged = putter(b)
-                while not stop.is_set():
-                    try:
-                        q.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                _put_checked(q, stop, staged)
                 if stop.is_set():
                     return
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
-            q.put((_SENTINEL, e))
+            _put_checked(q, stop, (_SENTINEL, e))
             return
-        q.put((_SENTINEL, None))
+        _put_checked(q, stop, (_SENTINEL, None))
 
     threading.Thread(target=worker, daemon=True).start()
     try:

@@ -1280,6 +1280,12 @@ class Crop:
     def infer(lp, in_shapes):
         a = nchw_view(in_shapes[0])
         b = nchw_view(in_shapes[1])
+        if len(a) != len(b):
+            # Caffe's CropLayer CHECKs num_axes equality
+            raise ValueError(
+                f"layer {lp.name!r}: crop bottoms must have equal rank, "
+                f"got {len(a)} vs {len(b)}"
+            )
         axis, _ = Crop._geom(lp, len(a))
         out = a[:axis] + b[axis:]
         if len(out) == 4:
@@ -1291,6 +1297,11 @@ class Crop:
     def apply(lp, params, state, inputs, ctx):
         x = inputs[0]
         ref_nchw = nchw_view(inputs[1].shape)
+        if len(ref_nchw) != x.ndim:
+            raise ValueError(
+                f"layer {lp.name!r}: crop bottoms must have equal rank, "
+                f"got {x.ndim} vs {len(ref_nchw)}"
+            )
         x_nchw4 = x.ndim == 4
         if x_nchw4:
             x = jnp.transpose(x, (0, 3, 1, 2))

@@ -29,7 +29,12 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: the feed's augmentation rng became per-batch default_rng((seed,
+# epoch, bi)) — required for O(1) skip(n) resume — which changes the
+# transform stream relative to v1-era snapshots, so resumes from them
+# would silently not be bit-identical. The version bump makes them fail
+# loudly instead.
+FORMAT_VERSION = 2
 _META_KEY = "__solverstate__"
 
 NPZ_SUFFIX = ".solverstate.npz"

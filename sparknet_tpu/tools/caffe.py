@@ -38,7 +38,11 @@ def _drop_gpu_flag(args: List[str]) -> List[str]:
     for a in args:
         if skip_value:
             skip_value = False
-            continue
+            # --gpu values are device ids or 'all', never dashed: a
+            # dashed token here means the value was omitted — keep it
+            # so argparse can report the real problem.
+            if not a.startswith("--"):
+                continue
         if a == "--gpu":
             skip_value = True
             continue
