@@ -78,4 +78,23 @@ else
   echo "TUNNEL-DEAD before dropout test" | tee -a "$LOG"
 fi
 
+say "flash pad-and-mask streaming at S=32k+8 (VMEM-bound check)"
+if probe; then
+  if ! timeout 600 python - <<'EOF' 2>>"$LOG.err" | tee -a "$LOG"
+import jax, jax.numpy as jnp
+from sparknet_tpu.ops.attention import flash_attention
+# 32776 is an 8-multiple whose gcd with 128 is 8: before the
+# pad-and-mask fix this silently became a full-axis block (VMEM blowup)
+q = jnp.zeros((1, 2, 32776, 64), jnp.bfloat16)
+out = jax.jit(lambda q: flash_attention(q, q, q, causal=True))(q)
+out.block_until_ready()
+print(f"flash S=32776 ok: out {out.shape} on {jax.devices()[0].platform}")
+EOF
+  then
+    echo "FAILED(flash-pad-32k) — see $LOG.err" | tee -a "$LOG"
+  fi
+else
+  echo "TUNNEL-DEAD before flash-pad-32k" | tee -a "$LOG"
+fi
+
 say "done ($(date -u +%FT%TZ))"

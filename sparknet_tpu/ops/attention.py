@@ -598,6 +598,35 @@ def _flash_vjp_bwd(
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _resolve_blocks(sq: int, sk: int, block_q: int, block_k: int):
+    """(pad_q, pad_k, block_q, block_k) for Mosaic block legality.
+
+    The q block must be a sublane (8) multiple and the k block a lane
+    (128) multiple, each dividing its (padded) axis. Rather than
+    snapping a non-conforming length to a *full-axis* block — which at
+    S=32k+ is exactly the VMEM blowup the streamed kernel exists to
+    avoid — the axes are padded up to granularity and the requested
+    blocks shrunk to the largest conforming divisor."""
+    requested_q = block_q
+    pad_q = -sq % 8
+    pad_k = -sk % 128
+    block_q = math.gcd(sq + pad_q, block_q)
+    if block_q % 8:
+        block_q = 8  # sq+pad_q is a sublane multiple, so 8 divides it
+    if block_q < min(requested_q, 128) and sq + pad_q > 1024:
+        # long sequence stuck with a sliver q-block (e.g. S=32k+8 →
+        # gcd 8): pad q to a lane multiple instead — ≤127 wasted rows
+        # buys full-height MXU tiles
+        pad_q = -sq % 128
+        block_q = math.gcd(sq + pad_q, max(requested_q, 128))
+        if block_q % 8:
+            block_q = 128  # sq+pad_q is a lane multiple, so 128 divides it
+    block_k = math.gcd(sk + pad_k, block_k)
+    if block_k % 128:
+        block_k = 128  # sk+pad_k is a lane multiple
+    return pad_q, pad_k, block_q, block_k
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -614,33 +643,41 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention on (B,H,S,D). Block sizes snap down to the
-    largest divisor of the sequence length (gcd with the requested
-    block), so any length works — 128-multiples get full-size MXU
-    blocks; prefer those. Offsets may be traced scalars — ring
-    attention passes per-step shard offsets.
+    """Flash attention on (B,H,S,D). Any sequence length works:
+    non-conforming lengths are zero-padded up to Mosaic's block
+    granularity (sublane multiple for q, lane multiple for k) with the
+    padded keys masked out and the padded query rows sliced off, so the
+    kernel always streams in O(block) VMEM — 128-multiples get
+    full-size MXU blocks with no padding; prefer those. Offsets may be
+    traced scalars — ring attention passes per-step shard offsets.
 
     Attention-probability dropout runs inside the kernels via the TPU
     PRNG, seeded per (batch, head, q-block, k-block) so forward and both
     backward passes regenerate identical keep masks."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = math.gcd(sq, block_q)
-    block_k = math.gcd(sk, block_k)
-    # Mosaic block legality: the q block must be a sublane multiple (or
-    # the whole axis), the k block a lane multiple (or the whole axis) —
-    # odd lengths fall back to full-axis blocks.
-    if block_q % 8:
-        block_q = sq
-    if block_k % 128:
-        block_k = sk
+    pad_q, pad_k, block_q, block_k = _resolve_blocks(
+        sq, sk, block_q, block_k
+    )
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if kv_mask is None:
         kv_mask = jnp.ones((b, sk), jnp.int8)
     else:
         kv_mask = kv_mask.astype(jnp.int8)
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        # padded keys are masked invalid: they add nothing forward, and
+        # the kernels' masked-p guard zeroes their dk/dv (sliced off
+        # below anyway); padded query rows only feed sliced-off outputs
+        # and receive zero cotangents, so dk/dv stay exact
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, pad_k)))
     # sublane-broadcast for the (1, 8, blk_k) mask block spec
-    kv_mask = jnp.broadcast_to(kv_mask[:, None, :], (b, 8, sk))
+    kv_mask = jnp.broadcast_to(
+        kv_mask[:, None, :], (b, 8, sk + pad_k)
+    )
     if dropout_rate > 0.0 and dropout_rng is not None:
         seed = jax.lax.bitcast_convert_type(
             jnp.asarray(dropout_rng).reshape(-1)[-1], jnp.int32
@@ -655,10 +692,11 @@ def flash_attention(
             seed,
         ]
     )
-    return _flash(
+    out = _flash(
         q, k, v, kv_mask, offsets, causal, scale, block_q, block_k,
         interpret, float(dropout_rate),
     )
+    return out[:, :, :sq] if pad_q else out
 
 
 def attention(

@@ -127,6 +127,71 @@ def test_flash_ragged_seq_snaps_blocks():
     )
 
 
+def test_resolve_blocks_never_full_axis():
+    """A non-conforming length must pad-and-mask, never silently snap
+    to a full-axis block (the S=32k VMEM blowup the streamed kernel
+    exists to avoid)."""
+    from sparknet_tpu.ops.attention import _resolve_blocks
+
+    # S = 32k + 8: an 8-multiple whose gcd with 128 is a sliver —
+    # both axes pad to lane multiples and keep full-size blocks
+    pad_q, pad_k, bq, bk = _resolve_blocks(32776, 32776, 128, 128)
+    assert (pad_q, pad_k, bq, bk) == (120, 120, 128, 128)
+    assert (32776 + pad_q) % bq == 0 and (32776 + pad_k) % bk == 0
+
+    # odd length: both axes pad, blocks stay at granularity
+    pad_q, pad_k, bq, bk = _resolve_blocks(13, 13, 128, 128)
+    assert (13 + pad_q) % 8 == 0 and (13 + pad_k) % 128 == 0
+    assert bq % 8 == 0 and bk % 128 == 0
+
+    # conforming lengths: no padding, full-size blocks
+    assert _resolve_blocks(4096, 4096, 128, 128) == (0, 0, 128, 128)
+
+    # an under-lane block request is raised to one lane tile, not
+    # bounced to the full axis
+    pad_q, pad_k, bq, bk = _resolve_blocks(4096, 4096, 64, 64)
+    assert (bq, bk) == (64, 128)
+
+    # awkward block requests (coprime-ish with the padded axis) must
+    # still come back sublane/lane legal
+    for req_q in (129, 132):
+        pad_q, pad_k, bq, bk = _resolve_blocks(32776, 32776, req_q, 128)
+        assert bq % 8 == 0 and (32776 + pad_q) % bq == 0, (req_q, bq)
+        assert bk % 128 == 0 and (32776 + pad_k) % bk == 0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_padded_lengths_match_reference(causal):
+    """Odd (sub-granularity) lengths run via pad-and-mask: forward and
+    grads match the reference exactly on the unpadded region."""
+    rng = np.random.default_rng(11)
+    q, k, v = rand_qkv(rng, b=1, h=2, sq=100, sk=77, d=32)
+
+    def f_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(jnp.cos(o)), o
+
+    def f_ref(q, k, v):
+        o = mha_reference(q, k, v, causal=causal)
+        return jnp.sum(jnp.cos(o)), o
+
+    (_, o1), g1 = jax.value_and_grad(f_flash, (0, 1, 2), has_aux=True)(
+        q, k, v
+    )
+    (_, o2), g2 = jax.value_and_grad(f_ref, (0, 1, 2), has_aux=True)(
+        q, k, v
+    )
+    assert o1.shape == (1, 2, 100, 32)
+    np.testing.assert_allclose(
+        np.asarray(o1), np.asarray(o2), rtol=2e-5, atol=2e-5
+    )
+    for a, b, name in zip(g1, g2, "qkv"):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+        )
+
+
 def test_flash_fully_padded_row():
     """A batch row whose kv_mask is all zero: forward exactly 0, grads
     exactly 0 (the reference path shares this contract)."""
