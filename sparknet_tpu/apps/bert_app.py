@@ -248,10 +248,13 @@ def run_model_parallel(args) -> Dict[str, float]:
     elif mode == "pp":
         from ..parallel.pipeline import make_pp_train_step, stack_layer_params
 
-        model = BertMLM(cfg, shapes, compute_dtype=cdt)
+        # --moe-experts composes: pp shards the layer stack, and an ep
+        # mesh axis additionally shards the expert stacks
+        ep = "ep" if (cfg.moe_num_experts > 0 and "ep" in axes) else None
+        model = BertMLM(cfg, shapes, compute_dtype=cdt, ep_axis=ep)
         step = make_pp_train_step(
             model, sp_param, mesh, n_micro=args.pp_microbatches,
-            dp_axis="dp",
+            dp_axis="dp", ep_axis=ep,
         )
     elif mode == "ep":
         from ..parallel.expert import make_ep_train_step
@@ -382,7 +385,12 @@ def main(argv=None) -> Dict[str, float]:
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     if args.parallel in ("tp", "sp", "pp", "ep"):
-        return run_model_parallel(args)
+        try:
+            return run_model_parallel(args)
+        finally:
+            # single-process today (run_model_parallel enforces it), but
+            # the goodbye must never depend on that staying true
+            multihost.stop_heartbeat()
     solver, feed, cfg = build(args)
     from ..solver.snapshot import solverstate_suffix
 
@@ -422,6 +430,7 @@ def main(argv=None) -> Dict[str, float]:
             f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
             f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
         )
+    multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return metrics
 
 

@@ -309,12 +309,6 @@ class BertMLM:
             aux_total = aux_total + aux
         return x, aux_total
 
-    def layer_apply(self, lp, x, kv_mask, *, rng=None, train=False):
-        """One encoder layer; see :meth:`layer_apply_with_aux` (this is
-        the aux-less view pipeline parallelism scans over)."""
-        out, _ = self.layer_apply_with_aux(lp, x, kv_mask, rng, train)
-        return out
-
     def layer_apply_with_aux(self, lp, x, kv_mask, rng=None, train=False):
         """One encoder layer (attention + FFN with post-LN residuals),
         returning (x, moe_aux).

@@ -47,15 +47,33 @@ def unstack_layer_params(stacked, rest, num_layers: int):
     return out
 
 
-def bert_pp_pspecs(model, pp_axis: str = "pp"):
+# expert stacks: leading layer axis shards over pp, the (now second)
+# expert axis over ep
+_EXPERT_NAMES = frozenset({"w_in", "b_in", "w_out", "b_out"})
+
+
+def bert_pp_pspecs(model, pp_axis: str = "pp", ep_axis=None):
     """(stacked_spec, rest_spec): layer stack sharded on its leading
-    axis over pp, everything else replicated."""
+    axis over pp, everything else replicated. For a MoE config the
+    layer dict holds expert stacks instead of dense FFN weights; with
+    ``ep_axis`` those additionally shard their expert dim."""
+    if getattr(model.cfg, "moe_num_experts", 0) > 0:
+        ffn_names = ["router_w", "w_in", "b_in", "w_out", "b_out"]
+    else:
+        ffn_names = ["ffn_in_w", "ffn_in_b", "ffn_out_w", "ffn_out_b"]
     names = [
         "q_w", "q_b", "k_w", "k_b", "v_w", "v_b", "out_w", "out_b",
-        "attn_ln_scale", "attn_ln_bias", "ffn_in_w", "ffn_in_b",
-        "ffn_out_w", "ffn_out_b", "ffn_ln_scale", "ffn_ln_bias",
+        "attn_ln_scale", "attn_ln_bias", *ffn_names,
+        "ffn_ln_scale", "ffn_ln_bias",
     ]
-    stacked_spec = {n: P(pp_axis) for n in names}
+    stacked_spec = {
+        n: (
+            P(pp_axis, ep_axis)
+            if ep_axis and n in _EXPERT_NAMES
+            else P(pp_axis)
+        )
+        for n in names
+    }
     rest_spec = {
         "embeddings": {
             "word": P(), "position": P(), "token_type": P(),
@@ -71,23 +89,28 @@ def bert_pp_pspecs(model, pp_axis: str = "pp"):
 
 def _stage_apply(model, stacked_local, x, kv_mask, rng, train, stage, l_loc,
                  micro_idx):
-    """Scan this rank's layers over x. rng folds in the *global* layer
-    index (decorrelates across stages) and the microbatch index
-    (decorrelates dropout across microbatches, matching the unpipelined
-    baseline where every batch row draws independent mask values)."""
+    """Scan this rank's layers over x; returns (y, moe_aux_sum). rng
+    folds in the *global* layer index (decorrelates across stages) and
+    the microbatch index (decorrelates dropout across microbatches,
+    matching the unpipelined baseline where every batch row draws
+    independent mask values)."""
 
     def body(carry, layer_params):
-        x, li = carry
+        x, li, aux = carry
         lrng = None
         if rng is not None:
             lrng = jax.random.fold_in(
                 jax.random.fold_in(rng, stage * l_loc + li), micro_idx
             )
-        y = model.layer_apply(layer_params, x, kv_mask, rng=lrng, train=train)
-        return (y, li + 1), None
+        y, a = model.layer_apply_with_aux(
+            layer_params, x, kv_mask, lrng, train
+        )
+        return (y, li + 1, aux + a), None
 
-    (y, _), _ = lax.scan(body, (x, 0), stacked_local)
-    return y
+    (y, _, aux), _ = lax.scan(
+        body, (x, 0, jnp.asarray(0.0, jnp.float32)), stacked_local
+    )
+    return y, aux
 
 
 def make_pp_train_step(
@@ -97,29 +120,52 @@ def make_pp_train_step(
     n_micro: int,
     dp_axis: Optional[str] = None,
     pp_axis: str = "pp",
+    ep_axis: Optional[str] = None,
 ):
     """Jitted ``step(params, opt_state, batch, it, rng)`` with the layer
-    stack pipelined over ``pp`` (optionally composed with ``dp``).
+    stack pipelined over ``pp`` (optionally composed with ``dp`` and,
+    for MoE configs, ``ep``).
 
     ``params``/``opt_state`` use the *stacked* layout:
     ``{"layers": stacked, "rest": rest}`` from
     :func:`stack_layer_params`. ``batch`` is token-level
     (:func:`sparknet_tpu.data.text.mlm_feed_tokens`); its leading batch
     dim must divide ``n_micro`` (× dp).
+
+    MoE composition: each stage scans its stacked expert layers; the
+    router aux loss is accumulated per (stage, live microbatch) through
+    the tick scan — the pipelined objective adds
+    ``moe_aux_weight * mean_over_microbatches(sum_over_layers(aux))``,
+    the microbatch-granular analogue of the unpipelined loss. With
+    ``ep_axis`` the expert stacks shard their expert dim and tokens
+    reach their expert's owner via the ``all_to_all`` inside
+    :func:`~sparknet_tpu.parallel.moe.moe_ffn`, exactly as in
+    :func:`~sparknet_tpu.parallel.expert.make_ep_train_step`.
     """
-    if getattr(getattr(model, "cfg", None), "moe_num_experts", 0) > 0:
-        raise NotImplementedError(
-            "pipeline parallelism is not wired to the MoE FFN path (the "
-            "stage pspecs and layer scan assume dense FFN params, and the "
-            "router aux loss would be dropped)"
+    cfg = model.cfg
+    moe = getattr(cfg, "moe_num_experts", 0) > 0
+    if ep_axis and not moe:
+        raise ValueError("ep_axis given but the config has no MoE experts")
+    if moe and model.ep_axis != ep_axis:
+        raise ValueError(
+            f"model.ep_axis ({model.ep_axis!r}) != ep_axis ({ep_axis!r}): "
+            "build the model with BertMLM(..., ep_axis=ep_axis)"
+        )
+    nep = mesh.shape[ep_axis] if ep_axis else 1
+    if moe and cfg.moe_num_experts % nep:
+        raise ValueError(
+            f"ep={nep} must divide moe_num_experts ({cfg.moe_num_experts})"
         )
     npp = mesh.shape[pp_axis]
     L = model.cfg.num_layers
     if L % npp:
         raise ValueError(f"pp={npp} must divide num_layers ({L})")
     l_loc = L // npp
+    ndp = mesh.shape[dp_axis] if dp_axis else 1
     data_axes = (dp_axis,) if dp_axis else ()
-    stacked_spec, rest_spec = bert_pp_pspecs(model, pp_axis)
+    stacked_spec, rest_spec = bert_pp_pspecs(
+        model, pp_axis, ep_axis if moe else None
+    )
     pspec = {"layers": stacked_spec, "rest": rest_spec}
 
     # layer lr/decay multipliers, stacked layout: identical per layer
@@ -162,7 +208,7 @@ def make_pp_train_step(
             ticks = n_micro + npp - 1
 
             def tick(carry, t):
-                recv, outs = carry
+                recv, outs, aux_acc = carry
                 mi_in = jnp.clip(t, 0, n_micro - 1)
                 inject = jnp.where(
                     is_first,
@@ -172,10 +218,14 @@ def make_pp_train_step(
                 # each tick, stage s processes microbatch t - s; mask
                 # for that microbatch (clamped during bubbles)
                 mi_here = jnp.clip(t - stage, 0, n_micro - 1)
-                y = _stage_apply(
+                y, aux = _stage_apply(
                     model, stacked, inject, mask_micro[mi_here], rng2,
                     True, stage, l_loc, mi_here,
                 )
+                # bubble ticks process clamped garbage whose outputs are
+                # never consumed — their aux must not be either
+                live_tick = jnp.logical_and(t >= stage, t - stage < n_micro)
+                aux_acc = aux_acc + jnp.where(live_tick, aux, 0.0)
                 recv_next = lax.ppermute(y, pp_axis, perm)
                 # last stage emits microbatch t - (npp - 1)
                 mi_out = t - (npp - 1)
@@ -186,12 +236,13 @@ def make_pp_train_step(
                     ),
                     outs,
                 )
-                return (recv_next, outs), None
+                return (recv_next, outs, aux_acc), None
 
             outs0 = jnp.zeros((n_micro, mb, s, h), x0.dtype)
             recv0 = jnp.zeros((mb, s, h), x0.dtype)
-            (_, outs), _ = lax.scan(
-                tick, (recv0, outs0), jnp.arange(ticks)
+            aux0 = jnp.asarray(0.0, jnp.float32)
+            (_, outs, aux_acc), _ = lax.scan(
+                tick, (recv0, outs0, aux0), jnp.arange(ticks)
             )
             xf = outs.reshape(b, s, h)
             nll, w, corr = model.token_loss_from_hidden(
@@ -203,10 +254,32 @@ def make_pp_train_step(
             w_tot = lax.psum(
                 batch["mlm_weights"].astype(jnp.float32).sum(), data_axes
             ) if data_axes else batch["mlm_weights"].astype(jnp.float32).sum()
+            # this stage's aux (already ep-pmean'd inside moe_ffn), mean
+            # over microbatches; /ndp so the dp-psum of grads carries
+            # the dp-mean (cf. make_ep_train_step)
+            aux_mean = aux_acc / n_micro
             loss_local = nll / jnp.maximum(w_tot, 1.0)
-            return loss_local, (nll, w_tot, corr)
+            if moe:
+                loss_local = (
+                    loss_local + cfg.moe_aux_weight * aux_mean / ndp
+                )
+            return loss_local, (nll, w_tot, corr, aux_mean)
 
-        grads, (nll, w_tot, corr) = jax.grad(loss_fn, has_aux=True)(params)
+        grads, (nll, w_tot, corr, aux_mean) = jax.grad(
+            loss_fn, has_aux=True
+        )(params)
+        if moe and ep_axis:
+            # tokens are replicated over ep: the all_to_all transpose
+            # accumulates one cotangent copy per ep rank into each
+            # expert shard — normalise them (cf. make_ep_train_step);
+            # non-expert leaves see identical grads on every ep rank
+            grads = {
+                "layers": {
+                    n: g / nep if n in _EXPERT_NAMES else g
+                    for n, g in grads["layers"].items()
+                },
+                "rest": grads["rest"],
+            }
         # pp reduction: replicated leaves ("rest") have grads only on the
         # stage that used them (embed on 0 unless... actually embed runs
         # on every rank but only stage 0's output enters the pipeline, so
@@ -226,9 +299,16 @@ def make_pp_train_step(
         params, opt_state = update(params, grads, opt_state, it)
         red = lambda z: lax.psum(z, data_axes + (pp_axis,))
         denom = jnp.maximum(w_tot, 1.0)
-        return params, opt_state, {
-            "loss": red(nll) / denom, "mlm_acc": red(corr) / denom,
-        }
+        metrics = {"loss": red(nll) / denom, "mlm_acc": red(corr) / denom}
+        if moe:
+            # stages hold disjoint layers: psum over pp completes the
+            # layer sum; dp shards see different tokens: mean
+            aux_all = lax.psum(aux_mean, pp_axis)
+            if data_axes:
+                aux_all = lax.pmean(aux_all, data_axes)
+            metrics["loss"] = metrics["loss"] + cfg.moe_aux_weight * aux_all
+            metrics["moe_aux"] = aux_all
+        return params, opt_state, metrics
 
     batch_axes = P(dp_axis) if dp_axis else P()
     batch_spec = {

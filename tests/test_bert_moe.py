@@ -118,12 +118,16 @@ def test_moe_param_specs_no_decay_on_expert_biases():
     assert set(layer) == set(params["layer_00"])
 
 
-def test_moe_bert_rejects_pipeline():
+def test_moe_bert_pipeline_needs_matching_ep_axis():
+    """MoE now composes with pp (tests/test_pipeline_parallel.py); the
+    guard that remains is ep-axis consistency between model and step."""
+    from sparknet_tpu.parallel.mesh import make_mesh
     from sparknet_tpu.parallel.pipeline import make_pp_train_step
 
-    model, _ = moe_model()
-    with pytest.raises(NotImplementedError):
-        make_pp_train_step(model, None, None, n_micro=2)
+    model, _ = moe_model()  # built without ep_axis
+    mesh = make_mesh({"pp": 2, "ep": 2}, jax.devices()[:4])
+    with pytest.raises(ValueError, match="ep_axis"):
+        make_pp_train_step(model, None, mesh, n_micro=2, ep_axis="ep")
 
 
 def test_moe_bert_rejects_tp_and_sp():
