@@ -403,6 +403,9 @@ def main(argv=None):
 
     with trace(args.profile_dir):
         result = train_loop(solver, train_feed, test_feed)
+    # training is done: leave the liveness fabric gracefully so the
+    # last host to finish isn't mistaken for a dead peer
+    multihost.stop_heartbeat()
     return result
 
 

@@ -231,7 +231,9 @@ def main(argv=None):
     from ..utils.profiling import trace
 
     with trace(args.profile_dir):
-        return train_loop(solver, train_feed, test_feed)
+        result = train_loop(solver, train_feed, test_feed)
+    multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
+    return result
 
 
 if __name__ == "__main__":

@@ -25,10 +25,238 @@ as the reference's RDD partitioning, minus the driver round-trip.
 from __future__ import annotations
 
 import os
+import socket
+import struct
+import sys
+import threading
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+# Exit code for "a peer host stopped responding": the launcher (or
+# scripts/launch_multihost.sh + --auto-resume) treats any non-zero exit
+# as restart-the-job. Distinct from ordinary crashes to aid triage.
+EXIT_PEER_FAILURE = 43
+
+_heartbeat: Optional["_Heartbeat"] = None
+
+
+class _Heartbeat:
+    """Out-of-band liveness fabric (SURVEY.md §5 failure handling).
+
+    Spark detects a dead executor via its driver<->executor heartbeats
+    and re-runs the lost partition. A JAX SPMD job has no driver and a
+    dead peer leaves every other host blocked *inside* a collective —
+    no exception, no timeout — so detection must live outside the
+    compute path entirely. This is a star of plain TCP pings through
+    process 0 (coordinator port + 1): workers ping every ``interval``
+    seconds; process 0 acks and tracks last-seen per worker. Whoever
+    observes silence longer than ``timeout`` prints a diagnostic and
+    hard-exits ``EXIT_PEER_FAILURE`` (``os._exit`` — the main thread
+    may be stuck in a collective and can't be unwound). A worker death
+    fails process 0; process 0's death fails every worker; a worker
+    noticing its own isolation fails transitively through 0.
+
+    Recovery is restart-level, exactly like the reference's driver
+    rescheduling a lost executor's work: relaunch the job and
+    ``--auto-resume`` resumes from the newest collective snapshot.
+    """
+
+    def __init__(self, host: str, port: int, pid: int, nprocs: int,
+                 interval: float, timeout: float):
+        self.host, self.port = host, port
+        self.pid, self.nprocs = pid, nprocs
+        self.interval, self.timeout = interval, timeout
+        self._stop = threading.Event()
+        self._threads = []
+        self._server = None
+        if pid == 0:
+            self._last_seen = {}
+            self._expected = set(range(1, nprocs))
+            self._lock = threading.Lock()
+            self._server = socket.create_server(
+                ("", port), backlog=nprocs, reuse_port=False
+            )
+            self._spawn(self._accept_loop)
+            self._spawn(self._monitor_loop)
+        else:
+            self._spawn(self._client_loop)
+
+    def _spawn(self, fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _die(self, why: str) -> None:
+        if self._stop.is_set():
+            return
+        print(
+            f"[sparknet multihost] process {self.pid}: {why} — exiting "
+            f"{EXIT_PEER_FAILURE} so the launcher can restart the job "
+            f"(--auto-resume recovers from the newest snapshot)",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(EXIT_PEER_FAILURE)
+
+    # -- process 0: server + monitor -----------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return  # closed
+            self._spawn(lambda c=conn: self._serve_one(c))
+
+    def _serve_one(self, conn: socket.socket):
+        with conn:
+            conn.settimeout(self.timeout)
+            while not self._stop.is_set():
+                try:
+                    raw = conn.recv(4)
+                    if len(raw) < 4:
+                        return  # peer closed; monitor ages it out
+                    (peer,) = struct.unpack("!i", raw)
+                    if peer < 0:  # graceful bye: stop expecting -1-peer
+                        with self._lock:
+                            self._expected.discard(-1 - peer)
+                            self._last_seen.pop(-1 - peer, None)
+                        conn.sendall(b"ok\n")
+                        return
+                    with self._lock:
+                        self._last_seen[peer] = time.monotonic()
+                    conn.sendall(b"ok\n")
+                except socket.timeout:
+                    return
+                except OSError:
+                    return
+
+    def _monitor_loop(self):
+        # workers must check in once within the join grace (they connect
+        # right after jax.distributed.initialize returns, which already
+        # required every process to be alive)
+        grace_until = time.monotonic() + max(3 * self.timeout, 30.0)
+        while not self._stop.is_set():
+            time.sleep(self.interval)
+            now = time.monotonic()
+            with self._lock:
+                seen = dict(self._last_seen)
+                expected = set(self._expected)
+            missing = expected - set(seen)
+            if missing and now > grace_until:
+                self._die(f"worker(s) {sorted(missing)} never joined the "
+                          f"heartbeat fabric")
+            stale = [
+                p for p, t in seen.items()
+                if p in expected and now - t > self.timeout
+            ]
+            if stale:
+                self._die(f"no heartbeat from worker(s) {sorted(stale)} "
+                          f"for {self.timeout:.0f}s (host dead or wedged)")
+
+    # -- workers: ping/ack client --------------------------------------
+
+    def _client_loop(self):
+        # one unified freshness clock: transient failures (including
+        # process 0 finishing and closing the server a beat before this
+        # worker stops) retry-with-reconnect until `timeout` elapses
+        # since the last good ack; only persistent silence kills
+        last_ok = time.monotonic()
+        joined = False
+        conn = None
+        ping = struct.pack("!i", self.pid)
+        while not self._stop.is_set():
+            if conn is None:
+                try:
+                    conn = socket.create_connection(
+                        (self.host, self.port),
+                        timeout=max(self.interval, 1.0),
+                    )
+                    conn.settimeout(self.timeout)
+                except OSError:
+                    conn = None
+            if conn is not None:
+                try:
+                    conn.sendall(ping)
+                    if conn.recv(3):
+                        last_ok = time.monotonic()
+                        joined = True
+                    else:
+                        raise OSError("server closed")
+                except OSError:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = None
+            limit = (
+                self.timeout if joined else max(3 * self.timeout, 30.0)
+            )
+            if time.monotonic() - last_ok > limit:
+                self._die(
+                    f"no heartbeat ack from process 0 for {limit:.0f}s "
+                    f"(host dead or wedged)"
+                )
+            self._stop.wait(self.interval)
+        # graceful leave: tell process 0 to stop expecting this worker
+        if conn is not None:
+            try:
+                conn.sendall(struct.pack("!i", -1 - self.pid))
+                conn.recv(3)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)  # let a worker deliver its bye
+
+
+def start_heartbeat(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    interval: Optional[float] = None,
+    timeout: Optional[float] = None,
+) -> Optional[_Heartbeat]:
+    """Start the liveness fabric (idempotent). ``SPARKNET_HEARTBEAT=0``
+    disables; ``SPARKNET_HEARTBEAT_TIMEOUT`` (seconds, default 15)
+    tunes how quickly a dead host fails the job;
+    ``SPARKNET_HEARTBEAT_PORT`` overrides coordinator-port+1."""
+    global _heartbeat
+    if _heartbeat is not None or num_processes <= 1:
+        return _heartbeat
+    if os.environ.get("SPARKNET_HEARTBEAT", "1") in ("0", ""):
+        return None
+    host, _, port_s = coordinator_address.rpartition(":")
+    port = int(os.environ.get("SPARKNET_HEARTBEAT_PORT", int(port_s) + 1))
+    timeout = timeout or float(
+        os.environ.get("SPARKNET_HEARTBEAT_TIMEOUT", "15")
+    )
+    interval = interval or max(0.2, timeout / 5.0)
+    _heartbeat = _Heartbeat(
+        host or "127.0.0.1", port, process_id, num_processes,
+        interval, timeout,
+    )
+    return _heartbeat
+
+
+def stop_heartbeat() -> None:
+    global _heartbeat
+    if _heartbeat is not None:
+        _heartbeat.close()
+        _heartbeat = None
 
 
 def initialize(
@@ -40,7 +268,12 @@ def initialize(
     active.  Arguments fall back to ``SPARKNET_COORDINATOR`` /
     ``SPARKNET_NUM_PROCESSES`` / ``SPARKNET_PROCESS_ID`` env vars (and
     then to JAX's own cluster auto-detection).  A single-process launch
-    (no coordinator configured) is a no-op."""
+    (no coordinator configured) is a no-op.
+
+    Once the cluster is up, a peer-liveness heartbeat fabric starts
+    (see :class:`_Heartbeat`): a dead host fails the whole job within
+    ``SPARKNET_HEARTBEAT_TIMEOUT`` seconds instead of leaving every
+    other host blocked in a collective."""
     coordinator_address = coordinator_address or os.environ.get(
         "SPARKNET_COORDINATOR"
     )
@@ -54,6 +287,9 @@ def initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+    )
+    start_heartbeat(
+        coordinator_address, jax.process_count(), jax.process_index()
     )
     return True
 

@@ -62,9 +62,29 @@ def main():
     assert jax.device_count() == 4 and jax.local_device_count() == 2
 
     solver = build_solver(
-        make_mesh({"dp": 4}), mode=mode, tau=2 if mode == "local" else 1
+        make_mesh({"dp": 4}), mode="sync" if mode == "droppeer" else mode,
+        tau=2 if mode == "local" else 1,
     )
     lo, hi = pid * GLOBAL_BS // 2, (pid + 1) * GLOBAL_BS // 2
+
+    if mode == "droppeer":
+        # liveness test: worker 1 dies hard after one step; process 0
+        # keeps stepping, blocks in the next collective, and must be
+        # killed by the heartbeat monitor (EXIT_PEER_FAILURE) instead
+        # of hanging forever
+        def feed():
+            while True:
+                for b in global_batches():
+                    yield {k: v[lo:hi] for k, v in b.items()}
+
+        m = solver.step(feed(), 1)
+        assert np.isfinite(float(m["loss"]))
+        if pid == 1:
+            print("worker 1: simulating host death", flush=True)
+            os._exit(7)
+        solver.step(feed(), 10_000)  # expected: killed by the watchdog
+        print("worker 0: UNEXPECTEDLY completed", flush=True)
+        return
 
     def feed():
         for b in global_batches():
@@ -80,6 +100,7 @@ def main():
         from sparknet_tpu.nets import weights as W
 
         W.save_npz(out, jax.device_get(solver.params))
+    multihost.stop_heartbeat()  # graceful leave, like the apps
     print(f"worker {pid}: done, loss={float(m['loss']):.6f}")
 
 

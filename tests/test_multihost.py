@@ -29,17 +29,23 @@ def test_helpers_single_process():
     assert multihost.host_shard(ds_like) is ds_like  # identity at 1 proc
 
 
-def _run_cluster(out, mode="sync"):
+def _spawn_cluster(out, mode="sync", extra_env=None):
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    procs = [
+    env["SPARKNET_HEARTBEAT_PORT"] = str(_free_port())
+    env.update(extra_env or {})
+    return [
         subprocess.Popen(
             [sys.executable, worker.__file__, coord, str(i), out, mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         for i in (0, 1)
     ]
+
+
+def _run_cluster(out, mode="sync"):
+    procs = _spawn_cluster(out, mode)
     logs = [p.communicate(timeout=600)[0].decode() for p in procs]
     assert all(p.returncode == 0 for p in procs), "\n".join(logs)
     return logs
@@ -61,6 +67,32 @@ def test_two_processes_match_single_process(tmp_path):
                 got[layer][name], np.asarray(arr), rtol=2e-5, atol=1e-6,
                 err_msg=f"{layer}.{name}",
             )
+
+
+def test_dead_peer_fails_the_job_fast(tmp_path):
+    """Live failure detection (SURVEY.md §5): worker 1 dies hard
+    mid-run; process 0 — blocked in a collective that will never
+    complete — must exit non-zero within the heartbeat timeout instead
+    of hanging until the job is killed externally."""
+    import time
+
+    from sparknet_tpu.parallel.multihost import EXIT_PEER_FAILURE
+
+    procs = _spawn_cluster(
+        str(tmp_path / "dead"), "droppeer",
+        extra_env={"SPARKNET_HEARTBEAT_TIMEOUT": "4"},
+    )
+    t0 = time.monotonic()
+    logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    elapsed = time.monotonic() - t0
+    assert procs[1].returncode == 7, logs[1]  # the simulated death
+    # process 0: killed by the heartbeat monitor (or by JAX's own
+    # distributed-runtime error if that fires first) — never 0, and
+    # fast (bound dominated by startup/compile, not by any hang)
+    assert procs[0].returncode not in (0, None), logs[0]
+    assert elapsed < 240, f"took {elapsed:.0f}s — detection too slow"
+    if procs[0].returncode == EXIT_PEER_FAILURE:
+        assert "no heartbeat" in logs[0]
 
 
 def test_local_mode_collective_snapshot(tmp_path):
