@@ -611,8 +611,21 @@ class LRN:
             scale = alpha / (size * size)
             k = 1.0
         ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), padding)
-        denom = jnp.power(k + scale * ssum, beta)
-        return [(x / denom).astype(x.dtype)], None
+        d = k + scale * ssum
+        # x * d^-beta. A general pow lowers to exp(beta*log(d)) — two
+        # transcendentals (plus more in its VJP) on the VPU for every
+        # element of a conv-sized tensor. The Caffe betas in the zoo are
+        # all dyadic, so build d^-beta from rsqrt/sqrt chains instead.
+        if beta == 0.75:
+            t = jnp.sqrt(lax.rsqrt(d))  # d^(-1/4)
+            inv = t * t * t
+        elif beta == 0.5:
+            inv = lax.rsqrt(d)
+        elif beta == 1.0:
+            inv = 1.0 / d
+        else:
+            inv = jnp.power(d, -beta)
+        return [(x * inv).astype(x.dtype)], None
 
 
 class Dropout:
