@@ -10,6 +10,7 @@ linear warmup + poly decay.
 from __future__ import annotations
 
 import argparse
+import os
 import time
 from typing import Dict
 
@@ -267,6 +268,9 @@ def run_model_parallel(args) -> Dict[str, float]:
     else:  # pragma: no cover — guarded by argparse choices
         raise ValueError(mode)
 
+    from ..solver.snapshot import resolve_prefix
+
+    args.snapshot_prefix = resolve_prefix(args.snapshot_prefix)
     params, _ = model.init(jax.random.PRNGKey(args.seed))
     if mode == "pp":
         stacked, rest = stack_layer_params(params, cfg.num_layers)
@@ -364,7 +368,9 @@ def parser() -> argparse.ArgumentParser:
                     help="snapshot every N iters (Solver modes: full "
                          "solver state, resumable; tp/sp/pp/ep modes: "
                          "params-only npz)")
-    ap.add_argument("--snapshot-prefix", default="bert")
+    ap.add_argument("--snapshot-prefix", default=os.path.join("runs", "bert"),
+                    help="CWD-relative like Caffe's snapshot_prefix; the "
+                         "default corrals artifacts under runs/")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
     ap.add_argument("--auto-resume", action="store_true",
@@ -395,8 +401,9 @@ def main(argv=None) -> Dict[str, float]:
     from ..solver.snapshot import solverstate_suffix
 
     solver.snapshot_suffix = solverstate_suffix(args.snapshot_format)
-    from ..solver.snapshot import apply_auto_resume
+    from ..solver.snapshot import apply_auto_resume, resolve_prefix
 
+    args.snapshot_prefix = resolve_prefix(args.snapshot_prefix)
     apply_auto_resume(args, args.snapshot_prefix)
     if args.restore:
         solver.restore(args.restore, feed)

@@ -211,8 +211,9 @@ def main(argv=None):
     from ..solver.snapshot import solverstate_suffix
 
     solver.snapshot_suffix = solverstate_suffix(args.snapshot_format)
-    from ..solver.snapshot import apply_auto_resume
+    from ..solver.snapshot import apply_auto_resume, resolve_prefix
 
+    solver.sp.snapshot_prefix = resolve_prefix(solver.sp.snapshot_prefix)
     apply_auto_resume(args, solver.sp.snapshot_prefix)
     if args.restore:
         solver.restore(args.restore, train_feed)

@@ -12,6 +12,7 @@ of ``jax.grad``. The elementwise algebra the reference implements by hand
 from __future__ import annotations
 
 import io
+import os
 from typing import Any, Dict
 
 import jax
@@ -59,6 +60,9 @@ def _unflatten(z) -> WeightCollection:
 
 
 def save_npz(path: str, w: WeightCollection) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     np.savez(path, **_flatten(w))
 
 

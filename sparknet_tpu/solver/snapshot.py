@@ -131,6 +131,7 @@ def save_state(path: str, **trees: Any) -> None:
     tmp dir and renames, giving the same guarantee."""
     import jax
 
+    ensure_parent(path)
     if path.endswith(ORBAX_SUFFIX):
         # Orbax's Checkpointer commits atomically itself (tmp dir +
         # rename, coordinated across processes) — no manual staging,
@@ -223,6 +224,26 @@ def resolve_auto_resume(prefix: str, explicit: Optional[str]) -> Optional[str]:
             )
         return cand
     return path
+
+
+def resolve_prefix(prefix: str) -> str:
+    """Snapshot prefixes are CWD-relative, exactly like Caffe's
+    ``snapshot_prefix``. Set ``SPARKNET_RUN_DIR`` to corral run
+    artifacts into one directory instead; absolute prefixes pass
+    through. Parent directories are created at write time by the
+    savers, so a disabled-snapshot run creates nothing."""
+    if not prefix or os.path.isabs(prefix):
+        return prefix
+    run_dir = os.environ.get("SPARKNET_RUN_DIR", "")
+    return os.path.join(run_dir, prefix) if run_dir else prefix
+
+
+def ensure_parent(path: str) -> None:
+    """Create the directory a snapshot is about to land in (prefixes
+    may name a run directory that doesn't exist yet)."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
 
 
 def apply_auto_resume(args, prefix: str) -> None:
