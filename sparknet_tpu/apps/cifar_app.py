@@ -106,12 +106,26 @@ def make_native_feed(
     """Feed served by the C++ prefetching loader (sparknet_tpu.native):
     shuffle + crop/mirror/mean + batch assembly in native worker threads,
     Python only memcpys ready batches. Falls back to :func:`make_feed`
-    when the library can't be built."""
+    when the library can't be built, or when the dataset won't fit the
+    loader's in-RAM cache (it materialises every partition —
+    ``SPARKNET_NATIVE_CACHE_MB``, default 2048, bounds that)."""
     from .. import native
 
     if not native.available():
         return make_feed(ds, transformer, batch_size, seed)
-    parts = [ds.collect_partition(i) for i in range(ds.num_partitions)]
+    cap = float(os.environ.get("SPARKNET_NATIVE_CACHE_MB", "2048")) * 1e6
+    parts, total = [], 0
+    for i in range(ds.num_partitions):
+        p = ds.collect_partition(i)
+        total += sum(np.asarray(v).nbytes for v in p.values())
+        if total > cap:
+            print(
+                f"native loader: dataset exceeds "
+                f"SPARKNET_NATIVE_CACHE_MB={cap / 1e6:.0f} — using the "
+                f"python feed (partitions stay lazy)"
+            )
+            return make_feed(ds, transformer, batch_size, seed)
+        parts.append(p)
     images = np.concatenate([p["data"] for p in parts])
     labels = np.concatenate([p["label"] for p in parts])
     return native.NativeLoader(
@@ -238,7 +252,9 @@ def build(args) -> tuple:
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
     feed_fn = (
-        make_native_feed if getattr(args, "native_loader", False) else make_feed
+        make_feed
+        if getattr(args, "native_loader", "auto") == "off"
+        else make_native_feed  # auto/on: falls back if the lib won't build
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
@@ -346,8 +362,10 @@ def arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--synthetic-n", type=int, default=10000)
     ap.add_argument("--max-iter", type=int, default=0)
     ap.add_argument("--batch-size", type=int, default=0)
-    ap.add_argument("--native-loader", action="store_true",
-                    help="use the C++ prefetching data loader")
+    ap.add_argument("--native-loader", nargs="?", const="on", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="C++ prefetching data loader: auto (default — "
+                         "use it when the library builds), on, or off")
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
     ap.add_argument("--tau", type=int, default=10,

@@ -160,7 +160,9 @@ def build(args):
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
     feed_fn = (
-        make_native_feed if getattr(args, "native_loader", False) else make_feed
+        make_feed
+        if getattr(args, "native_loader", "auto") == "off"
+        else make_native_feed  # auto/on: falls back if the lib won't build
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
@@ -182,8 +184,10 @@ def parser() -> argparse.ArgumentParser:
                     default="none")
     ap.add_argument("--tau", type=int, default=10,
                     help="local-SGD sync period (the SparkNet τ knob)")
-    ap.add_argument("--native-loader", action="store_true",
-                    help="use the C++ prefetching data loader")
+    ap.add_argument("--native-loader", nargs="?", const="on", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="C++ prefetching data loader: auto (default — "
+                         "use it when the library builds), on, or off")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (TPU-native matmul dtype)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
