@@ -1,0 +1,14 @@
+"""sparknet_tpu — TPU-native SparkNet.
+
+Kept import-light: subpackages pull in jax only when used. The one
+top-level convenience is :func:`register_python_layer`, the Caffe
+``Python``-layer escape hatch (see nets/layers.py).
+"""
+
+
+def __getattr__(name):
+    if name == "register_python_layer":
+        from .nets.layers import register_python_layer
+
+        return register_python_layer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -166,6 +166,12 @@ def build(args):
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
+    # effective loader into the solverstate (see cifar_app.build)
+    from .. import native as _native
+
+    solver.env_meta["loader"] = (
+        "native" if isinstance(train_feed, _native.NativeLoader) else "python"
+    )
     return solver, train_feed, test_feed
 
 

@@ -1619,6 +1619,88 @@ class ContrastiveLoss:
         return [loss], None
 
 
+# ---------------------------------------------------------------------------
+# Caffe `Python` layer escape hatch.
+#
+# Caffe's Python layer loads a user class (python_param.module/.layer)
+# and calls its setup/forward/backward on host tensors. A host callback
+# per layer would serialize the TPU pipeline, so the TPU-native contract
+# is a *traceable* callable registry instead: the user registers a pure
+# JAX function (or a full infer/init/apply impl) under "module.layer",
+# and it is traced and fused into the jitted step like any built-in
+# layer — autodiff replaces the hand-written backward.
+
+PYTHON_LAYER_REGISTRY: Dict[str, Any] = {}
+
+
+def register_python_layer(name: str, impl: Any = None):
+    """Register a ``Python``-layer implementation (also a decorator).
+
+    ``impl`` is either a bare traceable callable
+    ``fn(inputs: list[Array], param_str: str) -> list[Array]`` —
+    stateless, shapes inferred with ``jax.eval_shape`` over *float32*
+    avals (a callable that demands integer inputs, e.g. index-taking
+    on a label bottom, will fail at net-build time; give it the full
+    protocol with an explicit ``infer`` instead) — or an object with
+    the full built-in layer protocol (``infer(lp, in_shapes)``,
+    ``init(lp, rng, in_shapes)``, ``apply(lp, params, state, inputs,
+    ctx)``) for layers that need params, state, integer-typed inputs,
+    or phase behavior.
+    ``name`` should match the prototxt's ``python_param`` as
+    ``"<module>.<layer>"``; a bare ``"<layer>"`` key acts as a
+    module-agnostic fallback.
+    """
+    if impl is None:
+        return lambda f: register_python_layer(name, f)
+    PYTHON_LAYER_REGISTRY[name] = impl
+    return impl
+
+
+class PythonLayer:
+    """Dispatch for Caffe ``Python`` layers via the callable registry."""
+
+    @staticmethod
+    def _impl(lp) -> Tuple[Any, str]:
+        p = lp.sub("python_param")
+        module = str(p.get("module", "")) if p else ""
+        layer = str(p.get("layer", "")) if p else ""
+        param_str = str(p.get("param_str", "")) if p else ""
+        for key in ((f"{module}.{layer}",) if module else ()) + (layer,):
+            if key in PYTHON_LAYER_REGISTRY:
+                return PYTHON_LAYER_REGISTRY[key], param_str
+        raise KeyError(
+            f"Python layer {lp.name!r} wants {module + '.' if module else ''}"
+            f"{layer} but nothing is registered under that name — call "
+            f"sparknet_tpu.register_python_layer({(module + '.' + layer) if module else layer!r}, fn) "
+            f"with a traceable callable before building the net"
+        )
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        impl, param_str = PythonLayer._impl(lp)
+        if hasattr(impl, "infer"):
+            return impl.infer(lp, in_shapes)
+        outs = jax.eval_shape(
+            lambda *xs: impl(list(xs), param_str),
+            *[jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes],
+        )
+        return [tuple(o.shape) for o in outs]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        impl, _ = PythonLayer._impl(lp)
+        if hasattr(impl, "init"):
+            return impl.init(lp, rng, in_shapes)
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        impl, param_str = PythonLayer._impl(lp)
+        if hasattr(impl, "apply"):
+            return impl.apply(lp, params, state, inputs, ctx)
+        return list(impl(list(inputs), param_str)), None
+
+
 LAYER_IMPLS = {
     "Convolution": Convolution,
     "Deconvolution": Deconvolution,
@@ -1665,4 +1747,5 @@ LAYER_IMPLS = {
     "LSTM": LSTM,
     "RNN": RNN,
     "SPP": SPP,
+    "Python": PythonLayer,
 }

@@ -616,11 +616,13 @@ def _resolve_blocks(sq: int, sk: int, block_q: int, block_k: int):
     if block_q < min(requested_q, 128) and sq + pad_q > 1024:
         # long sequence stuck with a sliver q-block (e.g. S=32k+8 →
         # gcd 8): pad q to a lane multiple instead — ≤127 wasted rows
-        # buys full-height MXU tiles
+        # buys taller MXU tiles. The block never exceeds requested_q
+        # (the caller's VMEM bound); sub-8 requests round up to the
+        # sublane minimum of 8, best-effort.
         pad_q = -sq % 128
-        block_q = math.gcd(sq + pad_q, max(requested_q, 128))
+        block_q = math.gcd(sq + pad_q, requested_q)
         if block_q % 8:
-            block_q = 128  # sq+pad_q is a lane multiple, so 128 divides it
+            block_q = 8  # sq+pad_q is a lane multiple, so 8 divides it
     block_k = math.gcd(sk + pad_k, block_k)
     if block_k % 128:
         block_k = 128  # sk+pad_k is a lane multiple

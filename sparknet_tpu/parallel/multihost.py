@@ -43,6 +43,19 @@ EXIT_PEER_FAILURE = 43
 _heartbeat: Optional["_Heartbeat"] = None
 
 
+def _recv_exactly(conn: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes or return what arrived before EOF.
+    TCP is a byte stream — a single recv may legally return a fragment
+    of a ping/ack, which must not be misread as peer-closed."""
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return buf
+        buf += chunk
+    return buf
+
+
 class _Heartbeat:
     """Out-of-band liveness fabric (SURVEY.md §5 failure handling).
 
@@ -59,6 +72,21 @@ class _Heartbeat:
     fails process 0; process 0's death fails every worker; a worker
     noticing its own isolation fails transitively through 0.
 
+    Non-goal (by design): the star cannot see a partition that cuts
+    two non-zero workers off from *each other* while both still reach
+    process 0.  That case only matters if workers talked directly —
+    they don't; all collectives go through the global mesh, and a mesh
+    partition wedges a collective, which stalls pings to/through 0 and
+    is detected.  Pairwise partition detection is therefore explicitly
+    out of scope; the fabric promises fail-fast on dead/isolated-from-0
+    hosts only.
+
+    Clean shutdown: ``close()`` on process 0 broadcasts a 3-byte
+    ``end`` to every connected worker before closing the server, so a
+    worker with tail work (local-mode τ tail, slow snapshot write)
+    disarms its watchdog instead of misreading the silence as process
+    0 dying and exiting ``EXIT_PEER_FAILURE``.
+
     Recovery is restart-level, exactly like the reference's driver
     rescheduling a lost executor's work: relaunch the job and
     ``--auto-resume`` resumes from the newest collective snapshot.
@@ -72,9 +100,12 @@ class _Heartbeat:
         self._stop = threading.Event()
         self._threads = []
         self._server = None
+        self._disarmed = False  # set when process 0 announced clean end
+        self._ending = False  # process 0: close() underway, answer "end"
         if pid == 0:
             self._last_seen = {}
             self._expected = set(range(1, nprocs))
+            self._conns = set()  # live worker conns, for the end broadcast
             self._lock = threading.Lock()
             self._server = socket.create_server(
                 ("", port), backlog=nprocs, reuse_port=False
@@ -90,7 +121,7 @@ class _Heartbeat:
         self._threads.append(t)
 
     def _die(self, why: str) -> None:
-        if self._stop.is_set():
+        if self._stop.is_set() or self._disarmed:
             return
         print(
             f"[sparknet multihost] process {self.pid}: {why} — exiting "
@@ -111,27 +142,36 @@ class _Heartbeat:
             self._spawn(lambda c=conn: self._serve_one(c))
 
     def _serve_one(self, conn: socket.socket):
-        with conn:
-            conn.settimeout(self.timeout)
-            while not self._stop.is_set():
-                try:
-                    raw = conn.recv(4)
-                    if len(raw) < 4:
-                        return  # peer closed; monitor ages it out
-                    (peer,) = struct.unpack("!i", raw)
-                    if peer < 0:  # graceful bye: stop expecting -1-peer
+        with self._lock:
+            self._conns.add(conn)
+        try:
+            with conn:
+                conn.settimeout(self.timeout)
+                while not self._stop.is_set():
+                    try:
+                        raw = _recv_exactly(conn, 4)
+                        if len(raw) < 4:
+                            return  # peer closed; monitor ages it out
+                        (peer,) = struct.unpack("!i", raw)
+                        if peer < 0:  # graceful bye: stop expecting -1-peer
+                            with self._lock:
+                                self._expected.discard(-1 - peer)
+                                self._last_seen.pop(-1 - peer, None)
+                            conn.sendall(b"ok\n")
+                            return
                         with self._lock:
-                            self._expected.discard(-1 - peer)
-                            self._last_seen.pop(-1 - peer, None)
-                        conn.sendall(b"ok\n")
+                            self._last_seen[peer] = time.monotonic()
+                        # during close()'s linger, every ping is answered
+                        # "end" so workers that were mid-reconnect when the
+                        # broadcast went out still learn of the clean finish
+                        conn.sendall(b"end" if self._ending else b"ok\n")
+                    except socket.timeout:
                         return
-                    with self._lock:
-                        self._last_seen[peer] = time.monotonic()
-                    conn.sendall(b"ok\n")
-                except socket.timeout:
-                    return
-                except OSError:
-                    return
+                    except OSError:
+                        return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
 
     def _monitor_loop(self):
         # workers must check in once within the join grace (they connect
@@ -180,10 +220,29 @@ class _Heartbeat:
             if conn is not None:
                 try:
                     conn.sendall(ping)
-                    if conn.recv(3):
+                    ack = _recv_exactly(conn, 3)
+                    if ack == b"end":
+                        # process 0 finished cleanly: disarm the
+                        # watchdog so tail work here (τ tail, slow
+                        # snapshot write) is not misread as 0 dying;
+                        # answer with the graceful bye so 0's linger
+                        # can finish as soon as everyone has heard
+                        self._disarmed = True
+                        try:
+                            conn.sendall(struct.pack("!i", -1 - self.pid))
+                            _recv_exactly(conn, 3)
+                        except OSError:
+                            pass
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        return
+                    if ack == b"ok\n":
                         last_ok = time.monotonic()
                         joined = True
                     else:
+                        # short read / unknown token = broken connection
                         raise OSError("server closed")
                 except OSError:
                     try:
@@ -213,6 +272,30 @@ class _Heartbeat:
                 pass
 
     def close(self):
+        if self.pid == 0 and self._server is not None:
+            # announce clean end so workers with tail work disarm their
+            # watchdog instead of exiting EXIT_PEER_FAILURE (the "end"
+            # rides the 3-byte ack slot of each worker's next ping)
+            self._ending = True
+            with self._lock:
+                conns = list(self._conns)
+            for c in conns:
+                try:
+                    c.sendall(b"end")
+                except OSError:
+                    pass
+            # linger one ping period so a worker that was mid-reconnect
+            # when the broadcast went out can reconnect, ping, and get
+            # "end" too — otherwise it would misread the vanished server
+            # as process 0 dying (clean shutdown happens once per job;
+            # a bounded wait is cheap). Ends early once every expected
+            # worker has said its graceful bye.
+            deadline = time.monotonic() + min(self.interval + 0.5, 5.0)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._expected:
+                        break
+                time.sleep(0.05)
         self._stop.set()
         if self._server is not None:
             try:
@@ -240,15 +323,34 @@ def start_heartbeat(
     if os.environ.get("SPARKNET_HEARTBEAT", "1") in ("0", ""):
         return None
     host, _, port_s = coordinator_address.rpartition(":")
-    port = int(os.environ.get("SPARKNET_HEARTBEAT_PORT", int(port_s) + 1))
+    if "SPARKNET_HEARTBEAT_PORT" in os.environ:
+        port = int(os.environ["SPARKNET_HEARTBEAT_PORT"])
+    else:
+        try:
+            port = int(port_s) + 1
+        except ValueError:
+            raise ValueError(
+                f"cannot derive a heartbeat port from coordinator "
+                f"address {coordinator_address!r} (expected host:port); "
+                f"set SPARKNET_HEARTBEAT_PORT explicitly or "
+                f"SPARKNET_HEARTBEAT=0 to disable the liveness fabric"
+            ) from None
     timeout = timeout or float(
         os.environ.get("SPARKNET_HEARTBEAT_TIMEOUT", "15")
     )
     interval = interval or max(0.2, timeout / 5.0)
-    _heartbeat = _Heartbeat(
-        host or "127.0.0.1", port, process_id, num_processes,
-        interval, timeout,
-    )
+    try:
+        _heartbeat = _Heartbeat(
+            host or "127.0.0.1", port, process_id, num_processes,
+            interval, timeout,
+        )
+    except OSError as e:
+        raise OSError(
+            f"heartbeat fabric could not bind port {port} "
+            f"(coordinator port + 1 may collide with another listener): "
+            f"{e}; set SPARKNET_HEARTBEAT_PORT to a free port or "
+            f"SPARKNET_HEARTBEAT=0 to disable"
+        ) from e
     return _heartbeat
 
 

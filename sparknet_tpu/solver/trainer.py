@@ -12,6 +12,7 @@ caller explicitly materialises metrics).
 from __future__ import annotations
 
 import os
+import sys
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
@@ -175,6 +176,11 @@ class Solver:
         from .snapshot import NPZ_SUFFIX
 
         self.snapshot_suffix = NPZ_SUFFIX
+        # environment facts that affect the data/RNG stream (e.g. which
+        # loader feeds training); saved into the solverstate so a resume
+        # in a changed environment warns instead of silently switching
+        # shuffle/augmentation streams
+        self.env_meta: Dict[str, Any] = {}
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         self._train_step = jax.jit(
@@ -247,6 +253,7 @@ class Solver:
             opt_state=self.opt_state,
             it=self.iter,
             rng=self.rng,
+            env=dict(self.env_meta),
         )
 
     def restore(self, path: str, feed=None) -> None:
@@ -255,6 +262,17 @@ class Solver:
         from . import snapshot
 
         st = snapshot.load_state(path)
+        saved_env = st.get("env") or {}
+        for key, saved in saved_env.items():
+            cur = self.env_meta.get(key)
+            if cur is not None and cur != saved and jax.process_index() == 0:
+                print(
+                    f"WARNING: resuming a run snapshotted with "
+                    f"{key}={saved!r} in an environment where "
+                    f"{key}={cur!r} — the shuffle/augmentation stream "
+                    f"will differ from the uninterrupted run",
+                    file=sys.stderr, flush=True,
+                )
         self.iter = int(st["it"])
         self.rng = jnp.asarray(st["rng"])
         self._loss_window.clear()  # a restarted Caffe starts empty

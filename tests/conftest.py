@@ -9,12 +9,18 @@ not honored.
 
 ``SPARKNET_TEST_TPU=1`` keeps the real backend instead, for the
 hardware-gated tests (scripts/tpu_measure.sh runs them that way).
+
+The suite is compile-bound (every jit traces + XLA-compiles), so a
+persistent compilation cache (``.jax_cache/``, gitignored) is enabled
+for all backends: a warm run skips recompilation entirely, keeping
+``pytest -m "not slow"`` inside a CI round's budget. Delete the dir to
+force cold compiles; ``SPARKNET_TEST_NO_CACHE=1`` disables it.
 """
 
 import os
 
 if os.environ.get("SPARKNET_TEST_TPU", "") not in ("", "0"):
-    pass  # real accelerator: leave the backend alone
+    import jax  # real accelerator: leave the backend alone
 else:
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -25,3 +31,12 @@ else:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+if os.environ.get("SPARKNET_TEST_NO_CACHE", "") in ("", "0"):
+    _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(_cache_dir))
+    # cache every entry, however small/fast — the suite's cost is many
+    # medium compiles, not a few giant ones
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -1,0 +1,134 @@
+"""Caffe `Python` layer escape hatch: traceable callable registry.
+
+The reference's Caffe engine loads python_param.module/.layer and runs
+host-side setup/forward/backward (SURVEY.md §2 Caffe engine; mount
+empty, no file:line). The TPU-native twin registers a *traceable*
+callable instead, fused into the jitted step — these tests pin the
+contract: bare-callable dispatch with eval_shape inference, the full
+infer/init/apply protocol, module-qualified lookup with bare fallback,
+gradient flow through the custom layer, and the unregistered error.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.nets import layers as L
+from sparknet_tpu.nets.xlanet import XLANet
+
+
+def _net(text):
+    return caffe_pb.load_net(text, is_path=False)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    saved = dict(L.PYTHON_LAYER_REGISTRY)
+    L.PYTHON_LAYER_REGISTRY.clear()
+    yield
+    L.PYTHON_LAYER_REGISTRY.clear()
+    L.PYTHON_LAYER_REGISTRY.update(saved)
+
+
+NET_TXT = """
+name: "pynet"
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 2 dim: 8 } } }
+layer { name: "py" type: "Python" bottom: "data" top: "py"
+        python_param { module: "my_layers" layer: "DoubleShift"
+                       param_str: "3.5" } }
+layer { name: "ip" type: "InnerProduct" bottom: "py" top: "ip"
+        inner_product_param { num_output: 4
+          weight_filler { type: "xavier" } } }
+"""
+
+
+def test_bare_callable_end_to_end():
+    L.register_python_layer(
+        "my_layers.DoubleShift",
+        lambda inputs, param_str: [2.0 * inputs[0] + float(param_str)],
+    )
+    net = XLANet(_net(NET_TXT), "TRAIN", {"data": (2, 8)})
+    assert net.blob_shapes["py"] == (2, 8)  # eval_shape inference
+    params, state = net.init(jax.random.PRNGKey(0))
+    assert params.get("py", {}) == {}  # stateless: no params
+    x = np.linspace(-1, 1, 16).reshape(2, 8).astype(np.float32)
+    blobs, _ = net.apply(params, state, {"data": jnp.asarray(x)},
+                         train=False, rng=None)
+    w = np.asarray(params["ip"]["weight"])
+    np.testing.assert_allclose(
+        np.asarray(blobs["ip"]), (2.0 * x + 3.5) @ w, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_bare_callable_is_differentiable():
+    L.register_python_layer(
+        "my_layers.DoubleShift",
+        lambda inputs, param_str: [2.0 * inputs[0] + float(param_str)],
+    )
+    net = XLANet(_net(NET_TXT), "TRAIN", {"data": (2, 8)})
+    params, state = net.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 8), jnp.float32)
+
+    def loss(p):
+        blobs, _ = net.apply(p, state, {"data": x}, train=False, rng=None)
+        return jnp.sum(blobs["ip"] ** 2)
+
+    g = jax.jit(jax.grad(loss))(params)  # autodiff replaces backward()
+    assert float(jnp.sum(jnp.abs(g["ip"]["weight"]))) > 0.0
+
+
+def test_bare_name_fallback_when_module_not_registered():
+    L.register_python_layer(
+        "DoubleShift",  # module-agnostic fallback key
+        lambda inputs, param_str: [inputs[0] + float(param_str)],
+    )
+    net = XLANet(_net(NET_TXT), "TRAIN", {"data": (2, 8)})
+    params, state = net.init(jax.random.PRNGKey(0))
+    x = np.ones((2, 8), np.float32)
+    blobs, _ = net.apply(params, state, {"data": jnp.asarray(x)},
+                         train=False, rng=None)
+    np.testing.assert_allclose(np.asarray(blobs["py"]), x + 3.5, rtol=1e-6)
+
+
+def test_full_protocol_impl_with_params():
+    class Gain:
+        @staticmethod
+        def infer(lp, in_shapes):
+            return [in_shapes[0]]
+
+        @staticmethod
+        def init(lp, rng, in_shapes):
+            return {"gain": jnp.full((in_shapes[0][-1],), 2.0)}
+
+        @staticmethod
+        def apply(lp, params, state, inputs, ctx):
+            return [inputs[0] * params["gain"]], None
+
+    L.register_python_layer("my_layers.DoubleShift", Gain)
+    net = XLANet(_net(NET_TXT), "TRAIN", {"data": (2, 8)})
+    params, state = net.init(jax.random.PRNGKey(0))
+    assert params["py"]["gain"].shape == (8,)
+    x = np.full((2, 8), 3.0, np.float32)
+    blobs, _ = net.apply(params, state, {"data": jnp.asarray(x)},
+                         train=False, rng=None)
+    np.testing.assert_allclose(np.asarray(blobs["py"]), x * 2.0, rtol=1e-6)
+
+
+def test_unregistered_python_layer_raises():
+    with pytest.raises(KeyError, match="register_python_layer"):
+        XLANet(_net(NET_TXT), "TRAIN", {"data": (2, 8)})
+
+
+def test_decorator_registration():
+    @L.register_python_layer("my_layers.DoubleShift")
+    def double_shift(inputs, param_str):
+        return [2.0 * inputs[0] + float(param_str)]
+
+    assert L.PYTHON_LAYER_REGISTRY["my_layers.DoubleShift"] is double_shift
+    import sparknet_tpu
+
+    assert sparknet_tpu.register_python_layer is L.register_python_layer
