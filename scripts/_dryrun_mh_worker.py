@@ -1,0 +1,100 @@
+"""Phase-7 worker for ``dryrun_multichip``: multi-host composition.
+
+Run as:  python _dryrun_mh_worker.py <coordinator> <process_id>
+
+Two of these processes form a 2-process × 4-virtual-CPU-device cluster
+(8 global devices) and jit ONE dp2×tp4 BERT training step through the
+real deployment layer (``parallel/multihost.py``): ``jax.distributed``
+bring-up, heartbeat fabric, and — the point of the phase — the global
+batch entering through ``multihost.put_global`` /
+``jax.make_array_from_process_local_data``, so process-boundary
+sharding is exercised by the driver's own check, not only by tests.
+The dp axis deliberately spans the process boundary (first 4 devices
+are process 0's, last 4 process 1's); tp stays intra-process, the
+layout multi-host jobs want (tp collectives ride the fast local links).
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    coord, pid = sys.argv[1], int(sys.argv[2])
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    sys.path.insert(0, REPO)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparknet_tpu.data.text import mlm_dataset, mlm_feed_tokens
+    from sparknet_tpu.models.bert import BertConfig, BertMLM
+    from sparknet_tpu.parallel import make_mesh, multihost
+    from sparknet_tpu.parallel.tensor import (
+        bert_param_pspecs,
+        make_tp_train_step,
+    )
+    from sparknet_tpu.proto.caffe_pb import SolverParameter
+    from sparknet_tpu.solver.caffe_solver import init_opt_state
+
+    assert multihost.initialize(coord, 2, pid)
+    assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    c0 = BertConfig.bert_tiny(vocab_size=64)
+    cfg = type(c0)(**{**c0.__dict__, "num_heads": 4, "num_layers": 2})
+    b, s = 4, 32
+    bshapes = {"input_ids": (b, s), "mlm_positions": (b, 8)}
+    bsp = SolverParameter(
+        base_lr=1e-3, lr_policy="fixed", solver_type="ADAMW",
+        momentum=0.9, weight_decay=0.01, max_iter=10,
+    )
+
+    model = BertMLM(cfg, bshapes, tp_axis="tp")
+    # identical seed on every process -> identical host params; device_put
+    # against the global mesh sharding gives each process its shards
+    params_host, _ = model.init(jax.random.PRNGKey(0))
+    pspecs = bert_param_pspecs(model, "tp")
+    place = lambda tree, specs: jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(
+            np.asarray(x), NamedSharding(mesh, spec)
+        ),
+        tree, specs,
+    )
+    params = place(params_host, pspecs)
+    opt_host = init_opt_state(bsp, params_host)
+    opt = place(opt_host, {k: pspecs for k in opt_host})
+    repl = NamedSharding(mesh, P())
+    it0 = jax.device_put(np.asarray(0, np.int32), repl)
+    rng = jax.device_put(np.asarray(jax.random.PRNGKey(1)), repl)
+
+    step = make_tp_train_step(model, bsp, mesh, dp_axis="dp", tp_axis="tp")
+    ds, vs = mlm_dataset(vocab_size=64, n_tokens=2048, seq_len=s)
+    feed = mlm_feed_tokens(ds, b, vs, seed=0)  # same global stream everywhere
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    lo, hi = pid * b // 2, (pid + 1) * b // 2
+    metrics = None
+    for _ in range(2):
+        gb = next(feed)
+        local = {k: v[lo:hi] for k, v in gb.items()}  # host-local dp rows
+        gbatch = multihost.put_global(local, batch_sharding)
+        params, opt, metrics = step(params, opt, gbatch, it0, rng)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), f"non-finite multi-host loss {loss}"
+    multihost.stop_heartbeat()
+    print(f"worker {pid}: dp2.tp4 multi-host step ok, loss={loss:.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -258,16 +258,21 @@ def build(args) -> tuple:
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
-    # record the EFFECTIVE loader (auto may have fallen back) in the
-    # solverstate, so an --auto-resume in a changed environment (lib no
-    # longer builds, cache cap differs) warns about the silently
-    # different shuffle/augmentation RNG stream instead of hiding it
-    from .. import native as _native
+    record_loader_meta(solver, train_feed)
+    return solver, train_feed, test_feed
+
+
+def record_loader_meta(solver, train_feed) -> None:
+    """Record the EFFECTIVE loader (``--native-loader auto`` may have
+    fallen back) in the solverstate, so an ``--auto-resume`` in a
+    changed environment (lib no longer builds, cache cap differs) warns
+    about the silently different shuffle/augmentation RNG stream
+    instead of hiding it."""
+    from .. import native
 
     solver.env_meta["loader"] = (
-        "native" if isinstance(train_feed, _native.NativeLoader) else "python"
+        "native" if isinstance(train_feed, native.NativeLoader) else "python"
     )
-    return solver, train_feed, test_feed
 
 
 def train_loop(

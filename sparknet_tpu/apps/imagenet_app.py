@@ -28,7 +28,13 @@ from ..nets import weights as W
 from ..proto import caffe_pb
 from ..solver.trainer import Solver, resolve_model_path
 from ..parallel import ParallelSolver, make_mesh, multihost
-from .cifar_app import _batch_size, _data_layer, make_native_feed, train_loop
+from .cifar_app import (
+    _batch_size,
+    _data_layer,
+    make_native_feed,
+    record_loader_meta,
+    train_loop,
+)
 
 ZOO = os.path.join(os.path.dirname(__file__), "..", "models", "prototxt")
 
@@ -166,12 +172,7 @@ def build(args):
     )
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
-    # effective loader into the solverstate (see cifar_app.build)
-    from .. import native as _native
-
-    solver.env_meta["loader"] = (
-        "native" if isinstance(train_feed, _native.NativeLoader) else "python"
-    )
+    record_loader_meta(solver, train_feed)
     return solver, train_feed, test_feed
 
 

@@ -284,13 +284,15 @@ class _Heartbeat:
                     c.sendall(b"end")
                 except OSError:
                     pass
-            # linger one ping period so a worker that was mid-reconnect
-            # when the broadcast went out can reconnect, ping, and get
-            # "end" too — otherwise it would misread the vanished server
-            # as process 0 dying (clean shutdown happens once per job;
-            # a bounded wait is cheap). Ends early once every expected
-            # worker has said its graceful bye.
-            deadline = time.monotonic() + min(self.interval + 0.5, 5.0)
+            # linger one full ping period so a worker that was
+            # mid-reconnect when the broadcast went out can reconnect,
+            # ping, and get "end" too — otherwise it would misread the
+            # vanished server as process 0 dying. Must cover at least
+            # one interval (workers ping that often); ends early once
+            # every expected worker has said its graceful bye, which is
+            # the normal case, so the full wait is only paid for
+            # workers that are genuinely gone.
+            deadline = time.monotonic() + self.interval + 0.5
             while time.monotonic() < deadline:
                 with self._lock:
                     if not self._expected:
