@@ -44,9 +44,11 @@ if ! probe; then
 fi
 echo "# tpu_measure $(date -u +%FT%TZ)" >> "$LOG"
 
-say "bench: imagenet archs (compute-only)"
+say "bench: imagenet archs (compute-only; BENCH_E2E=0 — the dedicated
+e2e section below measures the pipeline, keeping each arch inside its
+600s budget)"
 for arch in alexnet googlenet resnet50 vgg16; do
-  BENCH_MODEL=$arch run_logged "bench-$arch" timeout 600 python bench.py
+  BENCH_MODEL=$arch BENCH_E2E=0 run_logged "bench-$arch" timeout 600 python bench.py
 done
 
 say "bench: bert (flash+fused-qkv default, analytic MFU)"

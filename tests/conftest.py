@@ -33,10 +33,27 @@ else:
     jax.config.update("jax_platforms", "cpu")
 
 if os.environ.get("SPARKNET_TEST_NO_CACHE", "") in ("", "0"):
-    _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    _cache_dir = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    )
+    # through BOTH the config (this process) and the env (so the many
+    # subprocess-spawning tests — app CLIs, multi-host clusters, bench
+    # invocations — share the same cache; jax reads these at init)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    # config mirrors the POST-setdefault env values, so a user-provided
+    # JAX_COMPILATION_CACHE_DIR keeps parent and subprocess tests in the
+    # SAME cache (the whole point) instead of splitting them
     jax.config.update("jax_compilation_cache_dir",
-                      os.path.abspath(_cache_dir))
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
     # cache every entry, however small/fast — the suite's cost is many
     # medium compiles, not a few giant ones
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        int(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes",
+        int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
+    )

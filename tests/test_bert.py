@@ -206,6 +206,7 @@ def test_bert_app_long_context_max_position():
         ("ep", ["--mesh", "dp=2,ep=4", "--moe-experts", "4"]),
     ],
 )
+@pytest.mark.slow
 def test_bert_app_model_parallel_modes(mode, extra):
     """Every model-parallel axis is reachable from the app CLI (the
     same step factories the driver dryrun exercises)."""

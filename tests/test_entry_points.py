@@ -12,6 +12,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 import jax
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,6 +28,7 @@ def test_entry_compiles_and_runs():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_all_axes():
     # conftest already forced the 8-device CPU mesh; _ensure_devices must
     # detect that and no-op. In the driver's process (1 axon device) it
@@ -61,6 +63,7 @@ def test_bench_alexnet_emits_json(monkeypatch, capsys):
     assert rec["tflops"] > 0
 
 
+@pytest.mark.slow
 def test_bench_alexnet_input_pipeline_mode(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_BATCH", "4")
     monkeypatch.setenv("BENCH_ITERS", "1")
@@ -69,6 +72,7 @@ def test_bench_alexnet_input_pipeline_mode(monkeypatch, capsys):
     assert rec["value"] > 0 and rec["input_pipeline"] == "1"
 
 
+@pytest.mark.slow
 def test_bench_alexnet_native_pipeline_mode(monkeypatch, capsys):
     from sparknet_tpu import native
 
@@ -83,6 +87,28 @@ def test_bench_alexnet_native_pipeline_mode(monkeypatch, capsys):
     assert rec["value"] > 0 and rec["input_pipeline"] == "native"
 
 
+@pytest.mark.slow
+def test_bench_e2e_subrecord_on_accelerator_path(monkeypatch):
+    """Accelerator runs append an input_pipeline sub-record (host-fed
+    loop vs compute-only). That branch is platform-gated off on CPU, so
+    cover its record assembly by faking the platform — otherwise its
+    first execution ever is an unattended tpu_measure.sh window, where
+    the defensive except would silently downgrade a bug to an error
+    field."""
+    import bench
+
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    monkeypatch.delenv("BENCH_PROFILE", raising=False)
+    monkeypatch.delenv("BENCH_INPUT_PIPELINE", raising=False)
+    rec = bench.bench_imagenet("fake-accel", "alexnet")
+    ip = rec["input_pipeline"]
+    assert ip["mode"] == "python+prefetch", ip
+    assert ip["img_per_sec"] > 0 and ip["iters"] >= 4
+    assert ip["vs_compute_only"] > 0
+
+
+@pytest.mark.slow
 def test_bench_bert_emits_json(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_MODEL", "bert")
     monkeypatch.setenv("BENCH_BATCH", "2")
@@ -93,6 +119,7 @@ def test_bench_bert_emits_json(monkeypatch, capsys):
     assert rec["value"] > 0 and "error" not in rec
 
 
+@pytest.mark.slow
 def test_bench_resnet50_emits_json(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_MODEL", "resnet50")
     monkeypatch.setenv("BENCH_BATCH", "2")

@@ -101,6 +101,7 @@ def test_imagenet_app_alexnet_synthetic_step():
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_imagenet_app_parallel_local_tau():
     """τ-local-SGD over the 8-device CPU mesh through the app path."""
     from sparknet_tpu.apps import imagenet_app

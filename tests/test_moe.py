@@ -95,6 +95,7 @@ def test_moe_sort_dispatch_matches_dense(top_k):
         )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dispatch", ["dense", "sort"])
 def test_moe_top2_ep_matches_single_device(dispatch):
     """top-2 + z-loss under ep=4 shard_map == unsharded, both dispatches."""

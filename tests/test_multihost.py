@@ -69,6 +69,7 @@ def test_two_processes_match_single_process(tmp_path):
             )
 
 
+@pytest.mark.slow
 def test_dead_peer_fails_the_job_fast(tmp_path):
     """Live failure detection (SURVEY.md §5): worker 1 dies hard
     mid-run; process 0 — blocked in a collective that will never
@@ -95,6 +96,7 @@ def test_dead_peer_fails_the_job_fast(tmp_path):
         assert "no heartbeat" in logs[0]
 
 
+@pytest.mark.slow
 def test_local_mode_collective_snapshot(tmp_path):
     """τ-local SGD across 2 processes: optimizer slots are dp-sharded
     across hosts; a snapshot must gather them collectively and still

@@ -94,6 +94,7 @@ def test_pp_step_matches_single_device():
             )
 
 
+@pytest.mark.slow
 def test_pp_dp_combined_trains():
     """dp=2 × pp=4 with dropout on: loss decreases."""
     b, s = 8, 32
@@ -132,6 +133,7 @@ def _moe_cfg(layers=2, experts=4):
     })
 
 
+@pytest.mark.slow
 def test_pp_ep_moe_step_matches_single_device():
     """pp=2 × ep=2 MoE pipelined step == a single-device step computing
     the identical per-microbatch objective (nll/w + aux_weight * mean
@@ -198,6 +200,7 @@ def test_pp_ep_moe_step_matches_single_device():
             )
 
 
+@pytest.mark.slow
 def test_pp_dp_ep_moe_trains():
     """dp=2 × pp=2 × ep=2 MoE with dropout on: loss decreases."""
     b, s = 8, 32

@@ -108,6 +108,7 @@ def test_latest_solverstate(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_cifar_app_restore_cli(tmp_path):
     """The CifarApp --restore flag end-to-end: snapshot at iter 2, resume
     to 4, matching the uninterrupted params exactly."""

@@ -87,6 +87,7 @@ def test_tp_step_matches_single_device():
             )
 
 
+@pytest.mark.slow
 def test_tp_sp_combined_trains():
     """3-D mesh dp=2×tp=2×sp=2: ring attention on tp-sharded heads."""
     b, s = 4, 64

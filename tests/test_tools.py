@@ -162,6 +162,7 @@ def cifar_app_args(solver_path, data_dir):
     )
 
 
+@pytest.mark.slow
 def test_convert_mnist_to_lenet_training(tmp_path):
     """idx files -> convert_mnist_data -> LMDB -> LeNet via the caffe
     CLI: the full published MNIST workflow on synthetic digits."""

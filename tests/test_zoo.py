@@ -69,6 +69,7 @@ def _one_step(solver_file, crop=224, bs=2, n=1):
     return s, feed
 
 
+@pytest.mark.slow
 def test_resnet50_trains():
     s, feed = _one_step("resnet50_solver.prototxt")
     m0 = {k: float(v) for k, v in s.step(feed(), 1).items()}
@@ -80,6 +81,7 @@ def test_resnet50_trains():
     assert m5["loss/loss"] < m0["loss/loss"]  # memorizes the fixed batch
 
 
+@pytest.mark.slow
 def test_googlenet_trains():
     s, feed = _one_step("bvlc_googlenet_quick_solver.prototxt")
     m = {k: float(v) for k, v in s.step(feed(), 1).items()}
