@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import attention
+from ..ops.matmul import mxu_dot
 
 
 @dataclasses.dataclass
@@ -323,17 +324,13 @@ class BertMLM:
         tp = self.tp_axis
 
         def proj(w, b_, t):
-            y = jnp.dot(
-                t, w.astype(cdt), preferred_element_type=jnp.float32
-            ) + b_
+            y = mxu_dot(t, w.astype(cdt)) + b_
             return y.astype(cdt)
 
         def row_proj(w, b_, t):
             """Row-parallel projection: local partial matmul, f/g-correct
             psum over tp (if sharded), replicated bias."""
-            y = jnp.dot(
-                t, w.astype(cdt), preferred_element_type=jnp.float32
-            )
+            y = mxu_dot(t, w.astype(cdt))
             if tp is not None:
                 y = _tp_reduce(y, tp)
             return (y + b_).astype(cdt)
@@ -428,18 +425,15 @@ class BertMLM:
         gathered = jnp.take_along_axis(x, pos[:, :, None], axis=1)  # (B,M,H)
         head = params["mlm_head"]
         t = jax.nn.gelu(
-            jnp.dot(
-                gathered, head["dense_w"].astype(x.dtype),
-                preferred_element_type=jnp.float32,
-            ) + head["dense_b"],
+            mxu_dot(gathered, head["dense_w"].astype(x.dtype))
+            + head["dense_b"],
             approximate=True,
         )
         t = _layer_norm(t, head["ln_scale"], head["ln_bias"], cfg.layer_norm_eps)
         logits = (
-            jnp.dot(
+            mxu_dot(
                 t.astype(self.compute_dtype),
                 params["embeddings"]["word"].T.astype(self.compute_dtype),
-                preferred_element_type=jnp.float32,
             )
             + head["output_bias"]
         )  # (B, M, V) f32
@@ -492,18 +486,14 @@ class BertMLM:
         cfg = self.cfg
         head = params["mlm_head"]
         t = jax.nn.gelu(
-            jnp.dot(
-                x, head["dense_w"].astype(x.dtype),
-                preferred_element_type=jnp.float32,
-            ) + head["dense_b"],
+            mxu_dot(x, head["dense_w"].astype(x.dtype)) + head["dense_b"],
             approximate=True,
         )
         t = _layer_norm(t, head["ln_scale"], head["ln_bias"], cfg.layer_norm_eps)
         logits = (
-            jnp.dot(
+            mxu_dot(
                 t.astype(self.compute_dtype),
                 params["embeddings"]["word"].T.astype(self.compute_dtype),
-                preferred_element_type=jnp.float32,
             )
             + head["output_bias"]
         )  # (B, S_local, V)

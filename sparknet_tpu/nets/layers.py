@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 
 from ..proto.caffe_pb import Filler, LayerParameter
+from ..ops.matmul import mxu_dot
 
 Shape = Tuple[int, ...]
 
@@ -434,9 +435,10 @@ class InnerProduct:
         x = inputs[0]
         x2 = x.reshape(x.shape[0], -1).astype(ctx.compute_dtype)
         w = params["weight"].astype(ctx.compute_dtype)
-        # unlike conv, dot's transpose rule handles a preferred f32
-        # output with bf16 operands, so keep guaranteed f32 accumulation
-        y = jnp.dot(x2, w, preferred_element_type=jnp.float32)
+        # mxu_dot: f32 accumulation forward AND compute-dtype
+        # backward dots (the default transpose rule would run the
+        # backward at f32 MXU rate — see ops/matmul.py)
+        y = mxu_dot(x2, w)
         if bias and "bias" in params:
             y = y + params["bias"]
         return [y.astype(ctx.compute_dtype)], None
@@ -1423,17 +1425,13 @@ class LSTM:
         wh = params["hidden_weight"].astype(cdt)
         b = params["bias"]
         # input contribution for every step in one batched matmul
-        gx = (
-            jnp.dot(x, wx, preferred_element_type=jnp.float32) + b
-        )  # (T, N, 4H) f32
+        gx = mxu_dot(x, wx) + b  # (T, N, 4H) f32
 
         def step(carry, inp):
             h_prev, c_prev = carry
             gxt, ct = inp
             h_in = (h_prev * ct[:, None]).astype(cdt)
-            gates = gxt + jnp.dot(
-                h_in, wh, preferred_element_type=jnp.float32
-            )
+            gates = gxt + mxu_dot(h_in, wh)
             i, f, o, g = jnp.split(gates, 4, axis=-1)
             i = jax.nn.sigmoid(i)
             f = jax.nn.sigmoid(f)
@@ -1482,18 +1480,13 @@ class RNN(LSTM):
         wx = params["weight"].astype(cdt)
         wh = params["hidden_weight"].astype(cdt)
         wo = params["out_weight"].astype(cdt)
-        gx = jnp.dot(x, wx, preferred_element_type=jnp.float32) + params["bias"]
+        gx = mxu_dot(x, wx) + params["bias"]
 
         def step(h_prev, inp):
             gxt, ct = inp
             h_in = (h_prev * ct[:, None]).astype(cdt)
-            h = jnp.tanh(
-                gxt + jnp.dot(h_in, wh, preferred_element_type=jnp.float32)
-            )
-            o = jnp.tanh(
-                jnp.dot(h.astype(cdt), wo, preferred_element_type=jnp.float32)
-                + params["out_bias"]
-            )
+            h = jnp.tanh(gxt + mxu_dot(h_in, wh))
+            o = jnp.tanh(mxu_dot(h.astype(cdt), wo) + params["out_bias"])
             return h, o
 
         zeros = jnp.zeros((n, hs), jnp.float32)

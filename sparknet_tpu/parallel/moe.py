@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.matmul import mxu_bmm
+
 
 def init_moe_params(
     rng: jax.Array,
@@ -213,20 +215,16 @@ def moe_ffn(
             expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True
         )
     cdt = compute_dtype
+    # mxu_bmm: per-expert (E, C, h) @ (E, h, f) at bf16 MXU rate in
+    # both directions with f32 accumulation (see ops/matmul.py) — these
+    # are the largest matmuls in an expert-parallel step
     y = jax.nn.gelu(
-        jnp.einsum(
-            "ech,ehf->ecf", expert_in.astype(cdt),
-            params["w_in"].astype(cdt),
-            preferred_element_type=jnp.float32,
-        )
+        mxu_bmm(expert_in.astype(cdt), params["w_in"].astype(cdt))
         + params["b_in"][:, None, :],
         approximate=True,
     )
     y = (
-        jnp.einsum(
-            "ecf,efh->ech", y.astype(cdt), params["w_out"].astype(cdt),
-            preferred_element_type=jnp.float32,
-        )
+        mxu_bmm(y.astype(cdt), params["w_out"].astype(cdt))
         + params["b_out"][:, None, :]
     )
     if ep_axis is not None:
