@@ -116,7 +116,9 @@ def time_per_layer(net, params, state, batch, iters: int = 10):
             outs, _ = impl.apply(lp, p_, st, inputs_, ctx)
             return outs
 
-        jfwd = jax.jit(fwd)
+        # compile ONCE (AOT) and use the executable for both the timing
+        # loop and cost analysis
+        jfwd = jax.jit(fwd).lower(p, inputs).compile()
         outs = jfwd(p, inputs)
         jax.block_until_ready(outs)
         t0 = time.perf_counter()
@@ -124,6 +126,16 @@ def time_per_layer(net, params, state, batch, iters: int = 10):
             outs = jfwd(p, inputs)
         jax.block_until_ready(outs)
         fwd_ms = 1000 * (time.perf_counter() - t0) / iters
+
+        # cost analysis separates compute-bound from HBM-bound layers:
+        # arithmetic intensity = FLOPs / bytes accessed (a layer far
+        # below the device's FLOP:byte ratio is bandwidth-limited no
+        # matter how its math is written)
+        from ..utils.profiling import cost_numbers
+
+        f, by = cost_numbers(jfwd)
+        gflop = f / 1e9 if f else None
+        gbyte = by / 1e9 if by else None
 
         bwd_ms = None
         # float outputs only: losses/metrics and feature maps; index
@@ -152,7 +164,7 @@ def time_per_layer(net, params, state, batch, iters: int = 10):
                 jax.block_until_ready(g)
                 bwd_ms = 1000 * (time.perf_counter() - t0) / iters
 
-        rows.append((lp.name, lp.type, fwd_ms, bwd_ms))
+        rows.append((lp.name, lp.type, fwd_ms, bwd_ms, gflop, gbyte))
         for top, out in zip(lp.top, outs):
             blobs[top] = out
     return rows
@@ -207,14 +219,22 @@ def main(argv=None):
             solver.train_net, solver.params, solver.state, batch,
             iters=max(3, args.iters // 5),
         )
-        print(f"{'layer':<28}{'type':<22}{'fwd ms':>10}{'bwd ms':>10}")
-        for name, ltype, fwd_ms, bwd_ms in rows:
+        print(f"{'layer':<28}{'type':<22}{'fwd ms':>10}{'bwd ms':>10}"
+              f"{'GFLOP':>9}{'GB':>8}{'F/B':>7}")
+        for name, ltype, fwd_ms, bwd_ms, gflop, gbyte in rows:
             b = f"{bwd_ms:.3f}" if bwd_ms is not None else "-"
-            print(f"{name:<28}{ltype:<22}{fwd_ms:>10.3f}{b:>10}")
+            gf = f"{gflop:.2f}" if gflop is not None else "-"
+            gb = f"{gbyte:.3f}" if gbyte is not None else "-"
+            ai = (f"{gflop / gbyte:.0f}"
+                  if gflop is not None and gbyte else "-")
+            print(f"{name:<28}{ltype:<22}{fwd_ms:>10.3f}{b:>10}"
+                  f"{gf:>9}{gb:>8}{ai:>7}")
         out["per_layer"] = [
             {"layer": n, "type": t, "forward_ms": round(f, 3),
-             "backward_ms": None if b is None else round(b, 3)}
-            for n, t, f, b in rows
+             "backward_ms": None if b is None else round(b, 3),
+             "gflop": None if gf is None else round(gf, 3),
+             "gbytes": None if gb is None else round(gb, 4)}
+            for n, t, f, b, gf, gb in rows
         ]
     return out
 

@@ -43,17 +43,29 @@ def device_peak_flops(device=None) -> Optional[float]:
     return None
 
 
+def cost_numbers(compiled) -> tuple:
+    """(flops, bytes_accessed) of an XLA ``Compiled`` per cost
+    analysis — None entries when the backend doesn't report. One home
+    for the API's quirks (list-vs-dict return, missing keys)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        b = float(cost.get("bytes accessed", 0.0))
+        return (f if f > 0 else None, b if b > 0 else None)
+    except Exception:
+        return (None, None)
+
+
 def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
     """FLOPs per execution of ``jitted(*args)`` per XLA cost analysis;
     None when the backend doesn't report."""
     try:
-        cost = jitted.lower(*args, **kwargs).compile().cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
-        f = float(cost.get("flops", 0.0))
-        return f if f > 0 else None
+        compiled = jitted.lower(*args, **kwargs).compile()
     except Exception:
         return None
+    return cost_numbers(compiled)[0]
 
 
 @contextlib.contextmanager
