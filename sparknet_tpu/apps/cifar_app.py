@@ -294,66 +294,115 @@ def train_loop(
         )
     t0 = time.time()
     last_test: Dict[str, float] = {}
-    # Caffe's pre-loop gate (Solver::Step):
-    # iter % test_interval == 0 && (iter > 0 || test_initialization) —
-    # a fresh solver tests once before training unless
-    # test_initialization: false; a solver RESUMED exactly on a test
-    # boundary re-runs that boundary's test before continuing.
-    if sp.test_interval and (
-        (solver.iter == 0 and sp.test_initialization)
-        or (solver.iter > 0 and solver.iter % sp.test_interval == 0)
-    ):
-        last_test = solver.test(test_feed)
-        for k, v in last_test.items():
-            log(f"    Test net output: {k} = {v:.4f}")
-    while solver.iter < sp.max_iter:
-        # stop at the nearest of: next test boundary, next snapshot
-        # boundary, max_iter — so neither cadence can skip the other's.
-        targets = [sp.max_iter]
-        for interval in (sp.test_interval, sp.snapshot):
-            if interval:
-                targets.append((solver.iter // interval + 1) * interval)
-        nxt = min(targets)
-        prev_iter = solver.iter
-        timer.update(0)  # reset the window to exclude eval/snapshot time
-        m = solver.step(
-            train_feed,
-            nxt - solver.iter,
-            log_fn=lambda it, mm: log(
-                f"Iteration {it}, loss = {mm.get('loss', float('nan')):.5f}"
-            ),
+
+    def write_snapshot() -> None:
+        path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
+        state_path = (
+            f"{sp.snapshot_prefix}_iter_{solver.iter}"
+            f"{solver.snapshot_suffix}"
         )
-        if sp.display:
-            if m:  # host sync so the window measures completed compute
-                jax.block_until_ready(next(iter(m.values())))
-            timer.update(solver.iter - prev_iter)
-            log(f"    speed: {timer.format()}")
-        at_end = solver.iter >= sp.max_iter
-        if (sp.test_interval and solver.iter % sp.test_interval == 0) or at_end:
+        # collective (gathers host-sharded optimizer slots); every
+        # process participates, only process 0 writes the files
+        solver.save(state_path)
+        if multihost.is_primary():
+            W.save_npz(path, solver.params)
+        log(f"Snapshotting to {path}")
+        log(f"Snapshotting solver state to {state_path}")
+
+    # Preemption grace (SURVEY.md §5 failure handling): on SIGTERM,
+    # finish the in-flight iteration, snapshot, and exit cleanly so a
+    # relaunch with --auto-resume loses no work. Single-process only:
+    # in multi-host mode the processes' handlers fire at different
+    # moments and a mid-chunk stop would desynchronise the collectives
+    # (recovery there is the heartbeat fabric + the periodic snapshot
+    # cadence). Installed only in the main thread (signal's rule).
+    preempt_old = None
+    if jax.process_count() == 1:
+        import signal as _signal
+
+        def _on_sigterm(signum, frame):
+            solver.stop_requested = True
+
+        try:
+            preempt_old = _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except ValueError:  # not the main thread (embedded use)
+            preempt_old = None
+
+    try:
+        # Caffe's pre-loop gate (Solver::Step):
+        # iter % test_interval == 0 && (iter > 0 || test_initialization)
+        # — a fresh solver tests once before training unless
+        # test_initialization: false; a solver RESUMED exactly on a test
+        # boundary re-runs that boundary's test before continuing.
+        if sp.test_interval and (
+            (solver.iter == 0 and sp.test_initialization)
+            or (solver.iter > 0 and solver.iter % sp.test_interval == 0)
+        ):
             last_test = solver.test(test_feed)
             for k, v in last_test.items():
                 log(f"    Test net output: {k} = {v:.4f}")
-        if (
-            sp.snapshot
-            and sp.snapshot_prefix
-            and (solver.iter % sp.snapshot == 0 or at_end)
-        ):
-            path = f"{sp.snapshot_prefix}_iter_{solver.iter}.npz"
-            state_path = (
-                f"{sp.snapshot_prefix}_iter_{solver.iter}"
-                f"{solver.snapshot_suffix}"
+        while solver.iter < sp.max_iter:
+            # stop at the nearest of: next test boundary, next snapshot
+            # boundary, max_iter — so neither cadence skips the other's.
+            targets = [sp.max_iter]
+            for interval in (sp.test_interval, sp.snapshot):
+                if interval:
+                    targets.append((solver.iter // interval + 1) * interval)
+            nxt = min(targets)
+            prev_iter = solver.iter
+            timer.update(0)  # reset window: exclude eval/snapshot time
+            m = solver.step(
+                train_feed,
+                nxt - solver.iter,
+                log_fn=lambda it, mm: log(
+                    f"Iteration {it}, "
+                    f"loss = {mm.get('loss', float('nan')):.5f}"
+                ),
             )
-            # collective (gathers host-sharded optimizer slots); every
-            # process participates, only process 0 writes the files
-            solver.save(state_path)
-            if multihost.is_primary():
-                W.save_npz(path, solver.params)
-            log(f"Snapshotting to {path}")
-            log(f"Snapshotting solver state to {state_path}")
+            if sp.display:
+                if m:  # host sync: the window measures completed compute
+                    jax.block_until_ready(next(iter(m.values())))
+                timer.update(solver.iter - prev_iter)
+                log(f"    speed: {timer.format()}")
+            if solver.stop_requested:
+                solver.stop_requested = False  # consumed: solver reusable
+                if sp.snapshot_prefix:
+                    write_snapshot()
+                    log(
+                        f"SIGTERM: preempted at iteration {solver.iter}; "
+                        f"snapshot written — relaunch with --auto-resume "
+                        f"to continue"
+                    )
+                else:
+                    log(
+                        f"SIGTERM: preempted at iteration {solver.iter}; "
+                        f"NO snapshot_prefix configured, progress since "
+                        f"the last snapshot is lost"
+                    )
+                break
+            at_end = solver.iter >= sp.max_iter
+            if (
+                sp.test_interval and solver.iter % sp.test_interval == 0
+            ) or at_end:
+                last_test = solver.test(test_feed)
+                for k, v in last_test.items():
+                    log(f"    Test net output: {k} = {v:.4f}")
+            if (
+                sp.snapshot
+                and sp.snapshot_prefix
+                and (solver.iter % sp.snapshot == 0 or at_end)
+            ):
+                write_snapshot()
+    finally:
+        if preempt_old is not None:
+            import signal as _signal
+
+            _signal.signal(_signal.SIGTERM, preempt_old)
+    done_iters = solver.iter
     dt = time.time() - t0
     log(
-        f"Optimization Done. {sp.max_iter} iters in {dt:.1f}s "
-        f"({sp.max_iter / max(dt, 1e-9):.1f} it/s)"
+        f"Optimization Done. {done_iters} iters in {dt:.1f}s "
+        f"({done_iters / max(dt, 1e-9):.1f} it/s)"
     )
     return last_test
 

@@ -152,6 +152,8 @@ class ParallelSolver(Solver):
         metrics: Dict[str, Any] = {}
         end = self.iter + n
         while self.iter < end:
+            if self.stop_requested:
+                break
             tau = min(self.tau, end - self.iter)
             stacked = stack_round_batches(
                 [self._next_iteration_batch(batches) for _ in range(tau)]

@@ -181,6 +181,9 @@ class Solver:
         # in a changed environment warns instead of silently switching
         # shuffle/augmentation streams
         self.env_meta: Dict[str, Any] = {}
+        # cooperative stop for preemption handling: step() returns at
+        # the next iteration boundary once set (see apps' train_loop)
+        self.stop_requested = False
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         self._train_step = jax.jit(
@@ -197,6 +200,8 @@ class Solver:
         at display boundaries)."""
         metrics = {}
         for _ in range(n):
+            if self.stop_requested:
+                break
             if self.sp.iter_size > 1:
                 micro = [next(batches) for _ in range(self.sp.iter_size)]
                 batch = jax.tree_util.tree_map(

@@ -218,3 +218,91 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "
         assert snapshot.latest_solverstate("ob") == f"ob_iter_4{snapshot.ORBAX_SUFFIX}"
     finally:
         os.chdir(cwd)
+
+
+@pytest.mark.slow  # two subprocess training runs (~25s warm)
+def test_sigterm_preemption_snapshot_and_resume(tmp_path):
+    """Preemption grace end-to-end (SURVEY.md §5 failure handling): a
+    real SIGTERM against the CifarApp process must finish the in-flight
+    iteration, write a solverstate, and exit 0; a relaunch with
+    --auto-resume must pick that snapshot up and run to completion."""
+    import glob
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    prefix = str(tmp_path / "pre")
+    solver_txt = tmp_path / "solver.prototxt"
+    base = (ZOO / "cifar10_quick_solver.prototxt").read_text()
+    base += f'\nsnapshot_prefix: "{prefix}"\n'
+    solver_txt.write_text(base)
+    base_cmd = [
+        sys.executable, "-m", "sparknet_tpu.apps.cifar_app",
+        "--solver", str(solver_txt), "--synthetic", "--synthetic-n", "1000",
+        "--batch-size", "8", "--seed", "3",
+    ]
+    cmd = base_cmd + ["--max-iter", "5000"]
+    env = dict(os.environ)
+    # force the subprocess onto CPU: repo-only PYTHONPATH (the axon
+    # tunnel plugin on the default path hangs jax.devices()) + explicit
+    # platform pin
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"  # readline() must see lines promptly
+    import threading
+
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # a reader thread drains stdout so the main thread can enforce the
+    # deadline even if the subprocess wedges before printing anything
+    lines = []
+    started = threading.Event()
+
+    def _drain():
+        for line in proc.stdout:
+            lines.append(line)
+            if "Test net output" in line:
+                started.set()
+
+    reader = threading.Thread(target=_drain, daemon=True)
+    reader.start()
+    try:
+        assert started.wait(timeout=300), "".join(lines)
+        _time.sleep(5)  # let a few training iterations run
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            raise AssertionError(
+                "SIGTERM did not stop the app:\n" + "".join(lines)
+            )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        reader.join(timeout=30)
+    full = "".join(lines)
+    assert proc.returncode == 0, full
+    assert "SIGTERM: preempted at iteration" in full, full
+    states = glob.glob(f"{prefix}_iter_*.solverstate.npz")
+    assert states, full
+    it = max(
+        int(re.search(r"_iter_(\d+)\.solverstate", s).group(1))
+        for s in states
+    )
+
+    # relaunch with --auto-resume: must restore and finish cleanly
+    out2 = subprocess.run(
+        base_cmd + ["--max-iter", str(it + 2), "--auto-resume"],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=300,
+    )
+    assert out2.returncode == 0, out2.stdout
+    assert "Restoring previous solver status" in out2.stdout, out2.stdout
+    assert "Optimization Done" in out2.stdout, out2.stdout
