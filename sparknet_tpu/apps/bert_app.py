@@ -433,15 +433,23 @@ def main(argv=None) -> Dict[str, float]:
         metrics = _fit(solver, feed, args, timer, primary)
     dt = time.time() - t0
     if primary:
+        done_iters = solver.iter  # may be < max_iter after a preemption
         print(
-            f"Optimization Done. {args.max_iter} iters in {dt:.1f}s "
-            f"({args.max_iter / max(dt, 1e-9):.1f} it/s)"
+            f"Optimization Done. {done_iters} iters in {dt:.1f}s "
+            f"({done_iters / max(dt, 1e-9):.1f} it/s)"
         )
     multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return metrics
 
 
 def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
+    from ..solver.preempt import preemption_grace
+
+    with preemption_grace(solver):
+        return _fit_loop(solver, feed, args, timer, primary)
+
+
+def _fit_loop(solver, feed, args, timer, primary) -> Dict[str, float]:
     metrics: Dict[str, float] = {}
     while solver.iter < args.max_iter:
         # stop at the nearest of: next display chunk, next snapshot
@@ -460,11 +468,18 @@ def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
                 f"mlm_acc = {mm['mlm_acc']:.4f}"
             ),
         )
-        metrics = {k: float(v) for k, v in m.items()}  # host sync
+        if m:  # a preempted chunk may return {} — keep the last real one
+            metrics = {k: float(v) for k, v in m.items()}  # host sync
         if primary and args.display:
             print(f"    speed: {timer.update(solver.iter - prev_iter).format()}")
+        preempted = solver.stop_requested
+        if preempted:
+            solver.stop_requested = False  # consumed: solver reusable
         at_end = solver.iter >= args.max_iter
-        if args.snapshot and (solver.iter % args.snapshot == 0 or at_end):
+        snap_now = preempted and args.snapshot_prefix
+        if (
+            args.snapshot and (solver.iter % args.snapshot == 0 or at_end)
+        ) or snap_now:
             path = (
                 f"{args.snapshot_prefix}_iter_{solver.iter}"
                 f"{solver.snapshot_suffix}"
@@ -472,6 +487,18 @@ def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
             solver.save(path)  # collective; process 0 writes
             if primary:
                 print(f"Snapshotting solver state to {path}")
+        if preempted:
+            if primary:
+                tail = (
+                    "snapshot written — relaunch with --auto-resume to "
+                    "continue" if snap_now else
+                    "NO snapshot prefix configured, progress since the "
+                    "last snapshot is lost"
+                )
+                print(
+                    f"SIGTERM: preempted at iteration {solver.iter}; {tail}"
+                )
+            break
     return metrics
 
 

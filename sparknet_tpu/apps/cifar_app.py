@@ -309,26 +309,9 @@ def train_loop(
         log(f"Snapshotting to {path}")
         log(f"Snapshotting solver state to {state_path}")
 
-    # Preemption grace (SURVEY.md §5 failure handling): on SIGTERM,
-    # finish the in-flight iteration, snapshot, and exit cleanly so a
-    # relaunch with --auto-resume loses no work. Single-process only:
-    # in multi-host mode the processes' handlers fire at different
-    # moments and a mid-chunk stop would desynchronise the collectives
-    # (recovery there is the heartbeat fabric + the periodic snapshot
-    # cadence). Installed only in the main thread (signal's rule).
-    preempt_old = None
-    if jax.process_count() == 1:
-        import signal as _signal
+    from ..solver.preempt import preemption_grace
 
-        def _on_sigterm(signum, frame):
-            solver.stop_requested = True
-
-        try:
-            preempt_old = _signal.signal(_signal.SIGTERM, _on_sigterm)
-        except ValueError:  # not the main thread (embedded use)
-            preempt_old = None
-
-    try:
+    with preemption_grace(solver):
         # Caffe's pre-loop gate (Solver::Step):
         # iter % test_interval == 0 && (iter > 0 || test_initialization)
         # — a fresh solver tests once before training unless
@@ -393,11 +376,6 @@ def train_loop(
                 and (solver.iter % sp.snapshot == 0 or at_end)
             ):
                 write_snapshot()
-    finally:
-        if preempt_old is not None:
-            import signal as _signal
-
-            _signal.signal(_signal.SIGTERM, preempt_old)
     done_iters = solver.iter
     dt = time.time() - t0
     log(

@@ -1,0 +1,47 @@
+"""Preemption grace: SIGTERM → cooperative stop (SURVEY.md §5).
+
+TPU-pod preemptions deliver SIGTERM with a grace window. Inside
+:func:`preemption_grace`, SIGTERM sets ``solver.stop_requested``; both
+``Solver.step`` and ``ParallelSolver.step`` check the flag at each
+iteration boundary and return early, letting the app's training loop
+snapshot and exit 0 so an ``--auto-resume`` relaunch loses no work.
+
+Single-process only: in multi-host mode the processes' handlers would
+fire at different moments and a mid-chunk stop would desynchronise the
+collectives — recovery there is the heartbeat fabric plus the periodic
+snapshot cadence. Installed only in the main thread (signal's rule);
+anywhere else this is a no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+
+import jax
+
+
+@contextlib.contextmanager
+def preemption_grace(solver):
+    old = None
+    installed = False
+    if jax.process_count() == 1:
+
+        def _on_sigterm(signum, frame):
+            solver.stop_requested = True
+
+        try:
+            old = signal.signal(signal.SIGTERM, _on_sigterm)
+            installed = True
+        except ValueError:  # not the main thread (embedded use)
+            installed = False
+    try:
+        yield
+    finally:
+        if installed:
+            # signal.signal returns None when the previous handler was
+            # installed by non-Python code; restoring None would raise,
+            # so fall back to the default disposition
+            signal.signal(
+                signal.SIGTERM, old if old is not None else signal.SIG_DFL
+            )
