@@ -504,9 +504,7 @@ def _flash_vjp_bwd(
     causal, scale, blk_q, blk_k, interpret, dropout_rate, res, do
 ):
     q, k, v, kv_mask, offsets, out, lse = res
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    nqb, nkb = sq // blk_q, sk // blk_k
+    b, h, sq, _ = q.shape
     lse = jnp.broadcast_to(lse[..., None], (b, h, sq, 128))
     delta = jnp.broadcast_to(
         jnp.sum(
@@ -515,6 +513,26 @@ def _flash_vjp_bwd(
         ),
         (b, h, sq, 128),
     )  # lane-replicated, same layout as lse
+    dq, dk, dv = _flash_bwd(
+        q, k, v, kv_mask, offsets, do, lse, delta, causal=causal,
+        scale=scale, blk_q=blk_q, blk_k=blk_k, interpret=interpret,
+        dropout_rate=dropout_rate,
+    )
+    return dq, dk, dv, None, None
+
+
+def _flash_bwd(
+    q, k, v, kv_mask, offsets, do, lse, delta, *, causal, scale,
+    blk_q, blk_k, interpret, dropout_rate,
+):
+    """The two backward pallas calls, reusable per ring block: ``lse``
+    and ``delta`` arrive lane-replicated (b, h, sq, 128) and may be the
+    GLOBAL (ring-merged) values — p = exp(s - lse) then yields the
+    exact global softmax probabilities for this kv block, which is what
+    makes flash-per-block ring backward exact."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nqb, nkb = sq // blk_q, sk // blk_k
 
     # dq: grid (b, h, nq, nk) — K/V streamed, dq carried in scratch
     dq_specs = _qk_specs(blk_q, blk_k, d) + [
@@ -592,10 +610,97 @@ def _flash_vjp_bwd(
         ],
         **_params(interpret),
     )(offsets, q, k, v, kv_mask, do, lse, delta)
-    return dq, dk, dv, None, None
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Raw per-block entry points for ring attention (parallel/sequence.py):
+# the ring orchestrates one fwd/bwd kernel pair per kv shard and owns
+# the cross-shard online-softmax merge + custom VJP itself.
+# ---------------------------------------------------------------------------
+
+
+def seed_from_rng(dropout_rng) -> jax.Array:
+    """int32 kernel seed from a PRNG key: last raw word, bitcast.
+    (A typed-key migration — jax.random.key — must update this one
+    place; flash_attention and the ring engines all route through it.)"""
+    return jax.lax.bitcast_convert_type(
+        jnp.asarray(dropout_rng).reshape(-1)[-1], jnp.int32
+    )
+
+
+def _ring_conditioning(q, k, kv_mask, block_q, block_k):
+    """(kv_mask8, blk_q, blk_k) for one conforming ring block: local
+    lengths must already satisfy Mosaic granularity (the ring dispatch
+    falls back to the einsum path otherwise)."""
+    b, _, sq, _ = q.shape
+    sk = k.shape[2]
+    if sq % 8 or sk % 128:
+        raise ValueError(
+            f"ring flash requires local S_q % 8 == 0 and S_kv % 128 == 0 "
+            f"(got {sq}, {sk}); use the einsum ring for odd shards"
+        )
+    blk_q = math.gcd(sq, block_q)
+    blk_k = math.gcd(sk, block_k)
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, sk), jnp.int8)
+    kv_mask8 = jnp.broadcast_to(
+        kv_mask.astype(jnp.int8)[:, None, :], (b, 8, sk)
+    )
+    return kv_mask8, blk_q, blk_k
+
+
+def flash_block_fwd(
+    q, k, v, kv_mask, *, q_offset, kv_offset, seed, causal, scale,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False, dropout_rate: float = 0.0,
+):
+    """One ring block's flash forward: (out, lse) with lse (B,H,Sq) —
+    normalized over THIS kv block only; merge across blocks via
+    logaddexp weights (see sequence.ring_attention's flash path)."""
+    kv_mask8, blk_q, blk_k = _ring_conditioning(
+        q, k, kv_mask, block_q, block_k
+    )
+    offsets = jnp.stack([
+        jnp.asarray(q_offset, jnp.int32),
+        jnp.asarray(kv_offset, jnp.int32),
+        jnp.asarray(seed, jnp.int32),
+    ])
+    out, lse = _flash_fwd(
+        q, k, v, kv_mask8, offsets, causal, scale, blk_q, blk_k,
+        interpret, float(dropout_rate),
+    )
+    return out, lse[..., 0]
+
+
+def flash_block_bwd(
+    q, k, v, kv_mask, do, lse, delta, *, q_offset, kv_offset, seed,
+    causal, scale, block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K, interpret: bool = False,
+    dropout_rate: float = 0.0,
+):
+    """One ring block's flash backward given the GLOBAL merged lse and
+    delta = sum(do*out) (both (B,H,Sq)): returns (dq_partial, dk, dv)
+    for this kv block."""
+    b, h, sq, _ = q.shape
+    kv_mask8, blk_q, blk_k = _ring_conditioning(
+        q, k, kv_mask, block_q, block_k
+    )
+    offsets = jnp.stack([
+        jnp.asarray(q_offset, jnp.int32),
+        jnp.asarray(kv_offset, jnp.int32),
+        jnp.asarray(seed, jnp.int32),
+    ])
+    lse128 = jnp.broadcast_to(lse[..., None], (b, h, sq, 128))
+    delta128 = jnp.broadcast_to(delta[..., None], (b, h, sq, 128))
+    return _flash_bwd(
+        q, k, v, kv_mask8, offsets, do, lse128, delta128, causal=causal,
+        scale=scale, blk_q=blk_q, blk_k=blk_k, interpret=interpret,
+        dropout_rate=float(dropout_rate),
+    )
 
 
 def _resolve_blocks(sq: int, sk: int, block_q: int, block_k: int):
@@ -681,9 +786,7 @@ def flash_attention(
         kv_mask[:, None, :], (b, 8, sk + pad_k)
     )
     if dropout_rate > 0.0 and dropout_rng is not None:
-        seed = jax.lax.bitcast_convert_type(
-            jnp.asarray(dropout_rng).reshape(-1)[-1], jnp.int32
-        )
+        seed = seed_from_rng(dropout_rng)
     else:
         dropout_rate = 0.0
         seed = jnp.asarray(0, jnp.int32)

@@ -24,6 +24,7 @@ have transpose rules), so the same code path trains.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Optional
 
@@ -61,11 +62,30 @@ def ring_attention(
     scale: Optional[float] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    impl: Optional[str] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Ring attention over sequence shards. Call inside ``shard_map``.
 
     q/k/v: local shards (B, H, S_local, D); kv_mask: local (B, S_local),
     True/1 = valid key. Returns the local output shard (B, H, S_local, D).
+
+    Two block engines, same ring:
+
+    - **flash** (TPU default for lane-aligned shards): each ring step
+      runs the streamed Pallas flash kernel on (q_local × kv shard) with
+      per-step q/kv offsets, merging the per-block ``(out, lse)`` pairs
+      with logaddexp weights; backward re-runs the ring calling the
+      flash backward kernels per block with the GLOBAL merged lse
+      (exact), accumulating dk/dv in carries that rotate with their kv
+      shard so every contribution lands home. HBM per step is O(S_local
+      * D) — the (S_local, S_local) logit block never materialises.
+    - **einsum** (fallback/oracle): materialises one f32 logit block per
+      step with an explicit online-softmax merge.
+
+    ``impl`` forces "flash"/"einsum" (env ``SPARKNET_RING_IMPL``
+    overrides the default); ``interpret`` runs the flash kernels in
+    Pallas interpret mode (CPU tests).
 
     Attention-probability dropout drops entries of the *unnormalised*
     online-softmax numerator p per ring step (keyed by the source shard
@@ -73,6 +93,179 @@ def ring_attention(
     keeps the undropped sum, matching the reference path's
     ``p/sum(p)``-then-drop semantics in expectation.
     """
+    b, h, s_loc, d = q.shape
+    if impl is None:
+        impl = os.environ.get("SPARKNET_RING_IMPL") or None
+    if impl is None:
+        from ..ops.attention import pltpu
+
+        impl = (
+            "flash"
+            if (
+                jax.default_backend() == "tpu"
+                and pltpu is not None
+                and s_loc % 128 == 0
+            )
+            else "einsum"
+        )
+    if impl not in ("flash", "einsum"):
+        raise ValueError(
+            f"ring impl {impl!r}: want 'flash' or 'einsum' "
+            f"(check SPARKNET_RING_IMPL)"
+        )
+    if impl == "flash":
+        scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+        mask = (
+            jnp.ones((b, s_loc), jnp.int8)
+            if kv_mask is None
+            else kv_mask.astype(jnp.int8)
+        )
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            from ..ops.attention import seed_from_rng
+
+            seed = seed_from_rng(dropout_rng)
+        else:
+            dropout_rate = 0.0
+            seed = jnp.asarray(0, jnp.int32)
+        return _ring_flash(
+            q, k, v, mask, seed, axis_name, causal, float(scale_v),
+            float(dropout_rate), interpret,
+        )
+    return _ring_einsum(
+        q, k, v, axis_name=axis_name, causal=causal, kv_mask=kv_mask,
+        scale=scale, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+    )
+
+
+def _ring_flash_steps(q, k, v, kv_mask, seed, axis_name, causal, scale,
+                      dropout_rate, interpret):
+    """Forward ring: one flash-fwd kernel call per kv shard, partials
+    merged by logaddexp weights. Returns (out f32, merged lse)."""
+    from ..ops.attention import flash_block_fwd
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    q_off = idx * s_loc
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        o, lse_acc, k_cur, v_cur, mask_cur, src = carry
+        o_s, lse_s = flash_block_fwd(
+            q, k_cur, v_cur, mask_cur,
+            q_offset=q_off, kv_offset=src * s_loc,
+            # decorrelate masks per (q shard, kv shard) — the kernel's
+            # own PRNG only sees block-local coordinates
+            seed=seed + src * jnp.int32(-1640531527)
+            + idx * jnp.int32(40503),
+            causal=causal, scale=scale, interpret=interpret,
+            dropout_rate=dropout_rate,
+        )
+        # NEG_INF is finite (-1e30), so dead rows merge NaN-free: their
+        # weights underflow to 0 and their o stays 0
+        lse_new = jnp.logaddexp(lse_acc, lse_s)
+        w1 = jnp.exp(lse_acc - lse_new)
+        w2 = jnp.exp(lse_s - lse_new)
+        o = o * w1[..., None] + o_s.astype(jnp.float32) * w2[..., None]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = lax.ppermute(mask_cur, axis_name, perm)
+        return (o, lse_new, k_nxt, v_nxt, mask_nxt, (src - 1) % n), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    (o, lse, *_), _ = lax.scan(
+        step, (o0, lse0, k, v, kv_mask, idx), None, length=n
+    )
+    return o, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, kv_mask, seed, axis_name, causal, scale,
+                dropout_rate, interpret):
+    o, _ = _ring_flash_steps(
+        q, k, v, kv_mask, seed, axis_name, causal, scale, dropout_rate,
+        interpret,
+    )
+    return o.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, kv_mask, seed, axis_name, causal, scale,
+                    dropout_rate, interpret):
+    o, lse = _ring_flash_steps(
+        q, k, v, kv_mask, seed, axis_name, causal, scale, dropout_rate,
+        interpret,
+    )
+    out = o.astype(q.dtype)
+    return out, (q, k, v, kv_mask, seed, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, dropout_rate, interpret,
+                    res, do):
+    from ..ops.attention import flash_block_bwd
+
+    q, k, v, kv_mask, seed, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    q_off = idx * s_loc
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B, H, S_local)
+
+    def step(carry, _):
+        dq, dk_acc, dv_acc, k_cur, v_cur, mask_cur, src = carry
+        dq_s, dk_s, dv_s = flash_block_bwd(
+            q, k_cur, v_cur, mask_cur, do, lse, delta,
+            q_offset=q_off, kv_offset=src * s_loc,
+            seed=seed + src * jnp.int32(-1640531527)
+            + idx * jnp.int32(40503),
+            causal=causal, scale=scale, interpret=interpret,
+            dropout_rate=dropout_rate,
+        )
+        dq = dq + dq_s.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their kv shard: add this
+        # device's contribution, then rotate both together — after the
+        # full circle every shard is home with its total gradient
+        dk_nxt = lax.ppermute(
+            dk_acc + dk_s.astype(jnp.float32), axis_name, perm
+        )
+        dv_nxt = lax.ppermute(
+            dv_acc + dv_s.astype(jnp.float32), axis_name, perm
+        )
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = lax.ppermute(mask_cur, axis_name, perm)
+        return (
+            dq, dk_nxt, dv_nxt, k_nxt, v_nxt, mask_nxt, (src - 1) % n
+        ), None
+
+    z = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (dq, dk, dv, *_), _ = lax.scan(
+        step, (z, z, z, k, v, kv_mask, idx), None, length=n
+    )
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        None, None,
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_einsum(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    kv_mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape

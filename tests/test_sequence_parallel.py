@@ -146,3 +146,60 @@ def test_ulysses_rejects_indivisible_heads():
     with pytest.raises(ValueError):
         _run_sp(partial(ulysses_attention, axis_name="sp"),
                 sp_mesh(), q, q, q, mask_j)
+
+
+@pytest.mark.slow  # interpret-mode flash kernels at lane-aligned shapes
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full_and_grads(causal):
+    """The flash-per-block ring engine (TPU default for lane-aligned
+    shards; Pallas interpret mode here): forward AND gradients must
+    match single-device full attention exactly — the custom VJP re-runs
+    the ring with the GLOBAL merged lse per block and rotates dk/dv
+    accumulators home with their kv shards."""
+    from sparknet_tpu.parallel.sequence import _ring_einsum
+
+    rng = np.random.default_rng(7)
+    b, h, s, d = 2, 2, 512, 64  # s_loc = 128: lane-aligned
+    q = rand(rng, (b, h, s, d))
+    k = rand(rng, (b, h, s, d))
+    v = rand(rng, (b, h, s, d))
+    mask = np.ones((b, s), np.int32)
+    mask[0, 400:] = 0
+    mask_j = jnp.asarray(mask)
+    mesh = sp_mesh()
+    fn = partial(ring_attention, axis_name="sp", causal=causal,
+                 impl="flash", interpret=True)
+
+    def run(fn_, q_, k_, v_):
+        return jax.shard_map(
+            lambda a, b_, c, m_: fn_(a, b_, c, kv_mask=m_),
+            mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3 + (P(None, "sp"),),
+            out_specs=P(None, None, "sp"), check_vma=False,
+        )(q_, k_, v_, mask_j)
+
+    out = run(fn, q, k, v)
+    ref = mha_reference(q, k, v, causal=causal, kv_mask=mask_j)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_sp(q_, k_, v_):
+        return jnp.sum(jnp.sin(run(fn, q_, k_, v_)))
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(jnp.sin(
+            mha_reference(q_, k_, v_, causal=causal, kv_mask=mask_j)))
+
+    g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_sp, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-5, atol=3e-5,
+            err_msg=f"d{name}",
+        )
+
+    # and the two ring engines agree with each other
+    fn_e = partial(_ring_einsum, axis_name="sp", causal=causal)
+    out_e = run(fn_e, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_e),
+                               rtol=2e-5, atol=2e-5)

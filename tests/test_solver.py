@@ -227,3 +227,50 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "
     np.testing.assert_allclose(
         logged[5][1], np.mean(raw[3:6]), rtol=1e-6
     )
+
+
+def test_stop_requested_cooperative_stop():
+    """stop_requested (the preemption-grace hook) must stop BOTH solver
+    types at an iteration boundary and leave the solver reusable once
+    the flag is cleared."""
+    from sparknet_tpu.parallel import ParallelSolver, make_mesh
+
+    sp = sp_from(
+        "base_lr: 0.01 lr_policy: 'fixed' max_iter: 100\n"
+        "net_param { name: 'n'\n"
+        "  layer { name: 'data' type: 'Input' top: 'data'\n"
+        "          input_param { shape { dim: 8 dim: 4 } } }\n"
+        "  layer { name: 'label' type: 'Input' top: 'label'\n"
+        "          input_param { shape { dim: 8 } } }\n"
+        "  layer { name: 'ip' type: 'InnerProduct' bottom: 'data' top: 'ip'\n"
+        "          inner_product_param { num_output: 3\n"
+        "            weight_filler { type: 'xavier' } } }\n"
+        "  layer { name: 'loss' type: 'SoftmaxWithLoss'\n"
+        "          bottom: 'ip' bottom: 'label' top: 'loss' } }"
+    )
+    import itertools
+
+    def feed():
+        batch = {
+            "data": jnp.ones((8, 4), jnp.float32),
+            "label": jnp.zeros((8,), jnp.int32),
+        }
+        return itertools.repeat(batch)
+
+    shapes = {"data": (8, 4), "label": (8,)}
+    for make in (
+        lambda: Solver(sp, shapes),
+        lambda: ParallelSolver(
+            sp, shapes, mesh=make_mesh({"dp": 2}, jax.devices()[:2]),
+            mode="local", tau=2,
+        ),
+    ):
+        solver = make()
+        solver.step(feed(), 4)
+        assert solver.iter == 4
+        solver.stop_requested = True
+        solver.step(feed(), 10)
+        assert solver.iter == 4  # stopped at the boundary, no progress
+        solver.stop_requested = False  # consumed -> reusable
+        solver.step(feed(), 2)
+        assert solver.iter == 6
