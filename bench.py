@@ -135,7 +135,9 @@ IMAGENET_ARCHS = {
 }
 
 
-def bench_imagenet(platform: str, arch: str = "alexnet") -> dict:
+def bench_imagenet(
+    platform: str, arch: str = "alexnet", _bs: int | None = None
+) -> dict:
     from sparknet_tpu.proto import caffe_pb
     from sparknet_tpu.solver.trainer import Solver
 
@@ -143,7 +145,9 @@ def bench_imagenet(platform: str, arch: str = "alexnet") -> dict:
     zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
     sp = caffe_pb.load_solver(os.path.join(zoo, proto))
 
-    bs = int(os.environ.get("BENCH_BATCH", tpu_bs if platform != "cpu" else 16))
+    bs = _bs or int(
+        os.environ.get("BENCH_BATCH", tpu_bs if platform != "cpu" else 16)
+    )
     compute_dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
     shapes = {"data": (bs, size, size, 3), "label": (bs,)}
     solver = Solver(sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype)
@@ -186,8 +190,27 @@ def bench_imagenet(platform: str, arch: str = "alexnet") -> dict:
     # block_until_ready can return before execution completes, so a
     # device->host read of a value data-dependent on the full step chain
     # is the only reliable fence.
-    m = solver.step(feed(), 2)  # warmup + compile
-    _fence(m)
+    try:
+        m = solver.step(feed(), 2)  # warmup + compile
+        _fence(m)
+    except Exception as e:
+        # unattended hardware windows must not die on a too-big default
+        # batch (VGG-16 activations at bs128 are near the HBM limit):
+        # halve and retry until it fits
+        if "RESOURCE_EXHAUSTED" in str(e) and bs >= 2:
+            # release this attempt's HBM (params, opt state, resident
+            # batch / prefetch buffers) BEFORE the retry allocates its
+            # own, or the halved run would OOM against our leftovers
+            # (m, if bound, holds only scalar metrics)
+            del solver, feed
+            if end_to_end:
+                del feed_iter
+            else:
+                del batch
+            out = bench_imagenet(platform, arch, _bs=bs // 2)
+            out["oom_retry_from_batch"] = bs
+            return out
+        raise
 
     flops_batch = _step_flops(solver, next(feed()))
     if flops_batch is None:

@@ -128,3 +128,26 @@ def test_bench_resnet50_emits_json(monkeypatch, capsys):
     assert rec["metric"] == "resnet50_train_images_per_sec_per_chip"
     assert rec["value"] > 0 and "error" not in rec
     assert rec["vs_baseline"] is None  # the K40 anchor is AlexNet-only
+
+
+def test_bench_oom_retry_halves_batch(monkeypatch):
+    """An unattended hardware window must survive a too-big default
+    batch: RESOURCE_EXHAUSTED during warmup halves the batch and
+    retries, recording the original in oom_retry_from_batch."""
+    import bench
+    from sparknet_tpu.solver import trainer
+
+    real_step = trainer.Solver.step
+
+    def fake_step(self, batches, n=1, log_fn=None):
+        if self.train_net.blob_shapes["data"][0] >= 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory (fake)")
+        return real_step(self, batches, n, log_fn)
+
+    monkeypatch.setattr(trainer.Solver, "step", fake_step)
+    monkeypatch.setenv("BENCH_BATCH", "4")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    rec = bench.bench_imagenet("cpu")
+    assert rec["batch_size"] == 2, rec
+    assert rec["oom_retry_from_batch"] == 4, rec
+    assert rec["value"] > 0
