@@ -190,6 +190,7 @@ def bench_imagenet(
     # block_until_ready can return before execution completes, so a
     # device->host read of a value data-dependent on the full step chain
     # is the only reliable fence.
+    oom_retry = False
     try:
         m = solver.step(feed(), 2)  # warmup + compile
         _fence(m)
@@ -198,19 +199,23 @@ def bench_imagenet(
         # batch (VGG-16 activations at bs128 are near the HBM limit):
         # halve and retry until it fits
         if "RESOURCE_EXHAUSTED" in str(e) and bs >= 2:
-            # release this attempt's HBM (params, opt state, resident
-            # batch / prefetch buffers) BEFORE the retry allocates its
-            # own, or the halved run would OOM against our leftovers
-            # (m, if bound, holds only scalar metrics)
-            del solver, feed
-            if end_to_end:
-                del feed_iter
-            else:
-                del batch
-            out = bench_imagenet(platform, arch, _bs=bs // 2)
-            out["oom_retry_from_batch"] = bs
-            return out
-        raise
+            oom_retry = True  # retry OUTSIDE the except block: the live
+            # exception's traceback pins Solver.step's frame (and with
+            # it the solver's device state) until the handler exits
+        else:
+            raise
+    if oom_retry:
+        # release this attempt's HBM (params, opt state, resident
+        # batch / prefetch buffers) BEFORE the retry allocates its own,
+        # or the halved run would OOM against our leftovers
+        del solver, feed
+        if end_to_end:
+            del feed_iter
+        else:
+            del batch
+        out = bench_imagenet(platform, arch, _bs=bs // 2)
+        out["oom_retry_from_batch"] = bs
+        return out
 
     flops_batch = _step_flops(solver, next(feed()))
     if flops_batch is None:

@@ -134,14 +134,21 @@ def nchw_view(shape) -> List[int]:
     return list(shape)
 
 
+def _spatial_geom(p):
+    """convolution_param's kernel/stride/pad/dilation (shared by
+    Convolution/Deconvolution via _conv_geom and by Im2col, which has
+    no num_output)."""
+    return (
+        _ints(p, "kernel_size", 0), _ints(p, "stride", 1),
+        _ints(p, "pad", 0), _ints(p, "dilation", 1),
+    )
+
+
 def _conv_geom(lp: LayerParameter):
     p = lp.convolution_param
     if p is None:
         raise ValueError(f"layer {lp.name}: missing convolution_param")
-    kh, kw = _ints(p, "kernel_size", 0)
-    sh, sw = _ints(p, "stride", 1)
-    ph, pw = _ints(p, "pad", 0)
-    dh, dw = _ints(p, "dilation", 1)
+    (kh, kw), (sh, sw), (ph, pw), (dh, dw) = _spatial_geom(p)
     group = int(p.get("group", 1))
     cout = int(p.get("num_output"))
     bias = bool(p.get("bias_term", True))
@@ -1612,6 +1619,103 @@ class ContrastiveLoss:
         return [loss], None
 
 
+class BatchReindex:
+    """Caffe BatchReindexLayer: top = bottom[0][bottom[1]] along the
+    batch axis (gather; autodiff gives the scatter-add backward)."""
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        if len(in_shapes[1]) != 1:
+            raise ValueError(
+                f"layer {lp.name!r}: BatchReindex wants a rank-1 index "
+                f"blob (Caffe's contract), got shape {in_shapes[1]}"
+            )
+        return [(in_shapes[1][0],) + tuple(in_shapes[0][1:])]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        idx = inputs[1].reshape(-1).astype(jnp.int32)
+        return [jnp.take(inputs[0], idx, axis=0)], None
+
+
+class Parameter:
+    """Caffe ParameterLayer: exposes a learnable blob as a top.
+    ``parameter_param { shape { dim ... } }``; Caffe initialises the
+    blob to zeros (values normally arrive via .caffemodel loading),
+    and so do we."""
+
+    @staticmethod
+    def _shape(lp) -> Shape:
+        p = lp.sub("parameter_param")
+        shp = p.get("shape") if p else None
+        if shp is None:
+            raise ValueError(
+                f"layer {lp.name!r}: Parameter needs parameter_param.shape"
+            )
+        return tuple(int(d) for d in shp.get_all("dim"))
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        return [Parameter._shape(lp)]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {"weight": jnp.zeros(Parameter._shape(lp), jnp.float32)}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        return [params["weight"]], None
+
+
+class Im2col:
+    """Caffe Im2colLayer: explicit patch extraction. NCHW Caffe emits
+    (N, C*kh*kw, Ho, Wo) with c-major column order; the NHWC twin emits
+    (N, Ho, Wo, C*kh*kw) with the SAME c-major feature order, so
+    column contents match Caffe's exactly (only the axis placement
+    follows this library's NHWC policy)."""
+
+    @staticmethod
+    def _geom(lp):
+        p = lp.convolution_param
+        if p is None:
+            raise ValueError(f"layer {lp.name}: missing convolution_param")
+        return _spatial_geom(p)
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw) = Im2col._geom(lp)
+        n, h, w, c = in_shapes[0]
+        return [(
+            n, _conv_out(h, kh, sh, ph, dh), _conv_out(w, kw, sw, pw, dw),
+            c * kh * kw,
+        )]
+
+    @staticmethod
+    def init(lp, rng, in_shapes):
+        return {}
+
+    @staticmethod
+    def apply(lp, params, state, inputs, ctx):
+        (kh, kw), (sh, sw), (ph, pw), (dh, dw) = Im2col._geom(lp)
+        x = inputs[0]
+        # conv_general_dilated_patches orders the output features
+        # c-major (source channel, then filter h, then filter w) — the
+        # exact Caffe column order
+        out = jax.lax.conv_general_dilated_patches(
+            x.astype(ctx.compute_dtype),
+            filter_shape=(kh, kw),
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return [out], None
+
+
 # ---------------------------------------------------------------------------
 # Caffe `Python` layer escape hatch.
 #
@@ -1741,4 +1845,7 @@ LAYER_IMPLS = {
     "RNN": RNN,
     "SPP": SPP,
     "Python": PythonLayer,
+    "BatchReindex": BatchReindex,
+    "Parameter": Parameter,
+    "Im2col": Im2col,
 }

@@ -657,3 +657,51 @@ def test_spp_rejects_too_deep_pyramid_and_missing_param():
         L.SPP.infer(lp, [(1, 7, 7, 2)])  # level 3 wants 8 bins on 7px
     with pytest.raises(ValueError, match="pyramid_height"):
         L.SPP.infer(lp_from('name: "s" type: "SPP"'), [(1, 8, 8, 2)])
+
+
+def test_batch_reindex_gather_and_grad():
+    x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(4, 6))
+    idx = jnp.asarray([2, 0, 2, 3, 1])
+    lp = lp_from('name: "r" type: "BatchReindex"')
+    assert L.BatchReindex.infer(lp, [(4, 6), (5,)]) == [(5, 6)]
+    (y,), _ = L.BatchReindex.apply(lp, {}, None, [x, idx], CTX)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x)[[2, 0, 2, 3, 1]])
+    # backward is scatter-add: row 2 selected twice gets gradient 2
+    g = jax.grad(
+        lambda x_: jnp.sum(L.BatchReindex.apply(lp, {}, None, [x_, idx], CTX)[0][0])
+    )(x)
+    np.testing.assert_allclose(np.asarray(g)[:, 0], [1.0, 1.0, 2.0, 1.0])
+
+
+def test_parameter_layer_exposes_blob():
+    lp = lp_from(
+        'name: "p" type: "Parameter" '
+        "parameter_param { shape { dim: 3 dim: 5 } }"
+    )
+    assert L.Parameter.infer(lp, []) == [(3, 5)]
+    params = L.Parameter.init(lp, jax.random.PRNGKey(0), [])
+    assert params["weight"].shape == (3, 5)
+    np.testing.assert_array_equal(np.asarray(params["weight"]), 0.0)
+    (y,), _ = L.Parameter.apply(lp, params, None, [], CTX)
+    assert y is params["weight"]
+
+
+@pytest.mark.parametrize("k,s,p,d", [(3, 1, 1, 1), (2, 2, 0, 1), (3, 1, 2, 2)])
+def test_im2col_vs_torch_unfold(k, s, p, d):
+    rng = np.random.default_rng(3)
+    n, c, h, w = 2, 3, 9, 7
+    x = rng.normal(size=(n, c, h, w)).astype(np.float32)
+    lp = lp_from(
+        f'name: "i" type: "Im2col" convolution_param {{ '
+        f"kernel_size: {k} stride: {s} pad: {p} dilation: {d} }}"
+    )
+    (y,), _ = L.Im2col.apply(lp, {}, None, [nhwc(x)], CTX)
+    ho, wo = y.shape[1], y.shape[2]
+    assert L.Im2col.infer(lp, [(n, h, w, c)]) == [(n, ho, wo, c * k * k)]
+    # torch unfold: (N, C*kh*kw, L) with c-major columns — the same
+    # feature order this layer documents
+    ref = F.unfold(
+        torch.from_numpy(x), kernel_size=k, stride=s, padding=p, dilation=d
+    ).numpy()  # (N, C*k*k, Ho*Wo)
+    got = np.asarray(y).reshape(n, ho * wo, c * k * k).transpose(0, 2, 1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
