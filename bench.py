@@ -150,7 +150,12 @@ def bench_imagenet(
     )
     compute_dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
     shapes = {"data": (bs, size, size, 3), "label": (bs,)}
-    solver = Solver(sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype)
+    solver = Solver(
+        sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype,
+        # BENCH_REMAT=1: per-layer remat (HBM-for-FLOPs; lets the deep
+        # nets keep their large batch instead of OOM-halving)
+        remat=os.environ.get("BENCH_REMAT", "0") not in ("", "0"),
+    )
 
     rng = np.random.default_rng(0)
     pipeline_mode = os.environ.get("BENCH_INPUT_PIPELINE", "0")

@@ -156,6 +156,7 @@ def build(args):
         solver_dir=solver_dir,
         seed=args.seed,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        remat=getattr(args, "remat", False),
     )
     if args.parallel == "none":
         solver = Solver(sp, shapes, **kw)
@@ -197,6 +198,11 @@ def parser() -> argparse.ArgumentParser:
                          "use it when the library builds), on, or off")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (TPU-native matmul dtype)")
+    ap.add_argument("--remat", action="store_true",
+                    help="per-layer rematerialization: recompute "
+                         "intra-layer intermediates in backward instead "
+                         "of keeping them in HBM (bigger batches on "
+                         "deep nets)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
     ap.add_argument("--auto-resume", action="store_true",

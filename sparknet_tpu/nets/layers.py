@@ -1639,7 +1639,11 @@ class BatchReindex:
     @staticmethod
     def apply(lp, params, state, inputs, ctx):
         idx = inputs[1].reshape(-1).astype(jnp.int32)
-        return [jnp.take(inputs[0], idx, axis=0)], None
+        # mode="clip": an out-of-range index (Caffe CHECK-fails at
+        # runtime; untraceable under jit) clamps to the batch edge
+        # instead of jnp.take's default fill-with-NaN, which would
+        # silently poison training
+        return [jnp.take(inputs[0], idx, axis=0, mode="clip")], None
 
 
 class Parameter:
@@ -1668,7 +1672,7 @@ class Parameter:
 
     @staticmethod
     def apply(lp, params, state, inputs, ctx):
-        return [params["weight"]], None
+        return [params["weight"].astype(ctx.compute_dtype)], None
 
 
 class Im2col:

@@ -44,10 +44,18 @@ class XLANet:
         phase: str = "TRAIN",
         input_shapes: Optional[Dict[str, Shape]] = None,
         compute_dtype: Any = jnp.float32,
+        remat: bool = False,
     ):
+        """``remat``: wrap each layer's apply in ``jax.checkpoint`` so
+        only layer-boundary blobs survive the forward pass — intra-layer
+        intermediates (BN normalization, LRN chains, dropout masks)
+        recompute during backward. The HBM-for-FLOPs trade for deep
+        BN-heavy nets (ResNet-50) at large batch; dropout recompute is
+        exact (masks are PRNG-keyed, not saved)."""
         self.net = net
         self.phase = phase
         self.compute_dtype = compute_dtype
+        self.remat = remat
         self.layers = [
             l for l in net.layers_for_phase(phase) if l.type not in ("Silence",)
         ]
@@ -127,9 +135,21 @@ class XLANet:
                 continue
             impl = LAYER_IMPLS[lp.type]
             layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
-            ctx = ApplyCtx(train=train, rng=layer_rng, compute_dtype=self.compute_dtype)
             inputs = [blobs[b] for b in lp.bottom]
-            outputs, st = impl.apply(lp, params.get(lp.name, {}), state.get(lp.name), inputs, ctx)
+
+            def run_layer(p, st_in, inputs_, rng_, lp=lp, impl=impl):
+                ctx = ApplyCtx(
+                    train=train, rng=rng_,
+                    compute_dtype=self.compute_dtype,
+                )
+                return impl.apply(lp, p, st_in, inputs_, ctx)
+
+            if self.remat and train:
+                run_layer = jax.checkpoint(run_layer)
+            outputs, st = run_layer(
+                params.get(lp.name, {}), state.get(lp.name), inputs,
+                layer_rng,
+            )
             for top, out in zip(lp.top, outputs):
                 blobs[top] = out
             if st is not None:

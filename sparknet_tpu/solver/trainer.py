@@ -122,6 +122,7 @@ class Solver:
         compute_dtype: Any = None,
         seed: int = 0,
         model: Any = None,
+        remat: bool = False,
     ):
         """``model``: any object satisfying the net protocol
         (``init/apply/loss_and_metrics/param_specs/input_names/
@@ -159,7 +160,10 @@ class Solver:
                     resolve_model_path(net_path, solver_dir)
                 )
         self.net_param = net_param
-        self.train_net = XLANet(net_param, "TRAIN", input_shapes, compute_dtype)
+        # remat applies to the train net only: eval keeps no backward
+        self.train_net = XLANet(
+            net_param, "TRAIN", input_shapes, compute_dtype, remat=remat
+        )
         self.test_net = XLANet(
             net_param, "TEST", test_input_shapes or input_shapes, compute_dtype
         )

@@ -683,7 +683,8 @@ def test_parameter_layer_exposes_blob():
     assert params["weight"].shape == (3, 5)
     np.testing.assert_array_equal(np.asarray(params["weight"]), 0.0)
     (y,), _ = L.Parameter.apply(lp, params, None, [], CTX)
-    assert y is params["weight"]
+    assert y.dtype == CTX.compute_dtype
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(params["weight"]))
 
 
 @pytest.mark.parametrize("k,s,p,d", [(3, 1, 1, 1), (2, 2, 0, 1), (3, 1, 2, 2)])

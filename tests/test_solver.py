@@ -274,3 +274,51 @@ def test_stop_requested_cooperative_stop():
         solver.stop_requested = False  # consumed -> reusable
         solver.step(feed(), 2)
         assert solver.iter == 6
+
+
+def test_remat_matches_no_remat():
+    """Per-layer rematerialization must be numerically transparent: the
+    same seed and batches give (near-)identical params after training,
+    including through BatchNorm state and PRNG-keyed dropout (masks
+    recompute from the same fold_in key, not from saved buffers)."""
+    import itertools
+
+    net_txt = """
+    net_param { name: 'remat'
+      layer { name: 'data' type: 'Input' top: 'data'
+              input_param { shape { dim: 4 dim: 8 dim: 8 dim: 3 } } }
+      layer { name: 'label' type: 'Input' top: 'label'
+              input_param { shape { dim: 4 } } }
+      layer { name: 'conv' type: 'Convolution' bottom: 'data' top: 'conv'
+              convolution_param { num_output: 6 kernel_size: 3 pad: 1
+                weight_filler { type: 'xavier' } } }
+      layer { name: 'bn' type: 'BatchNorm' bottom: 'conv' top: 'bn' }
+      layer { name: 'relu' type: 'ReLU' bottom: 'bn' top: 'bn' }
+      layer { name: 'drop' type: 'Dropout' bottom: 'bn' top: 'bn'
+              dropout_param { dropout_ratio: 0.3 } }
+      layer { name: 'ip' type: 'InnerProduct' bottom: 'bn' top: 'ip'
+              inner_product_param { num_output: 5
+                weight_filler { type: 'xavier' } } }
+      layer { name: 'loss' type: 'SoftmaxWithLoss'
+              bottom: 'ip' bottom: 'label' top: 'loss' } }
+    base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 max_iter: 20
+    """
+    sp = sp_from(net_txt)
+    shapes = {"data": (4, 8, 8, 3), "label": (4,)}
+    rng = np.random.default_rng(5)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 5, 4), jnp.int32),
+    }
+
+    def train(remat):
+        s = Solver(sp, shapes, seed=11, remat=remat)
+        s.step(itertools.repeat(batch), 5)
+        return jax.device_get(s.params), jax.device_get(s.state)
+
+    p0, st0 = train(False)
+    p1, st1 = train(True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        (p0, st0), (p1, st1),
+    )
