@@ -51,6 +51,12 @@ for arch in alexnet googlenet resnet50 vgg16; do
   BENCH_MODEL=$arch BENCH_E2E=0 run_logged "bench-$arch" timeout 600 python bench.py
 done
 
+say "bench: deep nets with per-layer remat (HBM-for-FLOPs datapoint)"
+for arch in resnet50 vgg16; do
+  BENCH_MODEL=$arch BENCH_REMAT=1 BENCH_E2E=0 \
+    run_logged "bench-$arch-remat" timeout 600 python bench.py
+done
+
 say "bench: bert (flash+fused-qkv default, analytic MFU)"
 BENCH_MODEL=bert run_logged "bench-bert" timeout 600 python bench.py
 
