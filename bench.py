@@ -285,6 +285,8 @@ def bench_imagenet(
         "step_ms": round(1000 * dt / iters, 2),
         "tflops": round(tflops, 2),
         "mfu": round(tflops * 1e12 / peak, 4) if peak else None,
+        # distinguishes BENCH_REMAT records in the append-only sweep log
+        "remat": solver.train_net.remat,
         "input_pipeline": pipeline_record,
     }
 

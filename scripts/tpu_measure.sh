@@ -51,6 +51,12 @@ for arch in alexnet googlenet resnet50 vgg16; do
   BENCH_MODEL=$arch BENCH_E2E=0 run_logged "bench-$arch" timeout 600 python bench.py
 done
 
+say "bench: alexnet batch curve (MFU vs batch — the first knob)"
+for bsz in 256 1024; do
+  BENCH_MODEL=alexnet BENCH_BATCH=$bsz BENCH_E2E=0 \
+    run_logged "bench-alexnet-bs$bsz" timeout 600 python bench.py
+done
+
 say "bench: deep nets with per-layer remat (HBM-for-FLOPs datapoint)"
 for arch in resnet50 vgg16; do
   BENCH_MODEL=$arch BENCH_REMAT=1 BENCH_E2E=0 \
