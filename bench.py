@@ -418,6 +418,11 @@ if __name__ == "__main__":
                     "unit": "tokens/sec" if bert else "images/sec",
                     "vs_baseline": 0.0 if mode == "alexnet" else None,
                     "platform": platform,
+                    # keep failed sweep-variant records attributable in
+                    # the append-only log, like the success records
+                    "remat": os.environ.get("BENCH_REMAT", "0")
+                    not in ("", "0"),
+                    "batch_size": os.environ.get("BENCH_BATCH"),
                     "error": f"{type(e).__name__}: {e}",
                 }
             )

@@ -151,3 +151,15 @@ def test_bench_oom_retry_halves_batch(monkeypatch):
     assert rec["batch_size"] == 2, rec
     assert rec["oom_retry_from_batch"] == 4, rec
     assert rec["value"] > 0
+
+
+def test_bench_remat_mode_emits_tagged_json(monkeypatch, capsys):
+    """BENCH_REMAT=1 is staged for unattended TPU windows; the path
+    (remat solver build + remat-tagged record) must be CI-exercised
+    before it first runs on hardware."""
+    monkeypatch.setenv("BENCH_BATCH", "2")
+    monkeypatch.setenv("BENCH_ITERS", "1")
+    monkeypatch.setenv("BENCH_REMAT", "1")
+    rec = _run_bench(capsys)
+    assert rec["value"] > 0 and "error" not in rec
+    assert rec["remat"] is True
