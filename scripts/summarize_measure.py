@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Summarise a tpu_measure.log into a RESULTS.md-ready markdown table.
+
+Usage: python scripts/summarize_measure.py [tpu_measure.log]
+
+Reads every JSON line in the log (bench.py records), de-duplicates by
+(metric, batch_size, remat, input-pipeline mode) keeping the LAST
+occurrence (the log is append-only across re-runs), and prints one
+markdown table plus any error/FAILED/TUNNEL-DEAD markers so gaps are
+visible rather than silently absent.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "tpu_measure.log"
+    rows: dict = {}
+    markers = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" not in r:
+                    continue
+                ip = r.get("input_pipeline")
+                ip_key = ip if isinstance(ip, (str, bool)) else "sub"
+                # str(): the failure path logs batch_size as the raw
+                # env string, success paths as int — same config must
+                # share one key so a re-run replaces its error row
+                key = (
+                    r["metric"], str(r.get("batch_size")),
+                    bool(r.get("remat")), ip_key,
+                )
+                rows[key] = r
+            elif "FAILED" in line or "TUNNEL-DEAD" in line:
+                markers.append(line)
+
+    print("| metric | value | unit | batch | step ms | TFLOP/s | MFU "
+          "| remat | e2e/pipeline | vs_baseline |")
+    print("|---" * 10 + "|")
+    for r in rows.values():
+        ip = r.get("input_pipeline")
+        if isinstance(ip, dict):
+            ipcell = (
+                f"{ip.get('img_per_sec', '?')} img/s "
+                f"({ip.get('vs_compute_only', '?')}x)"
+                if "img_per_sec" in ip else ip.get("error", "err")
+            )
+        else:
+            ipcell = str(ip)
+        print(
+            f"| {r['metric']} | {r.get('value')} | {r.get('unit')} "
+            f"| {r.get('batch_size')} | {r.get('step_ms')} "
+            f"| {r.get('tflops')} | {r.get('mfu')} | {r.get('remat')} "
+            f"| {ipcell} | {r.get('vs_baseline')} |"
+        )
+        if "error" in r:
+            markers.append(f"{r['metric']}: {r['error']}")
+    if markers:
+        print("\nGaps / failures:")
+        for m in markers:
+            print(f"- {m}")
+
+
+if __name__ == "__main__":
+    main()

@@ -327,7 +327,9 @@ def test_preemption_grace_noop_off_main_thread():
         except Exception as e:  # pragma: no cover
             results["error"] = e
 
-    t = threading.Thread(target=run)
+    # daemon: if the context ever wedges, the join timeout must report
+    # the failure instead of blocking interpreter exit forever
+    t = threading.Thread(target=run, daemon=True)
     t.start()
     t.join(timeout=30)
     assert results.get("entered") is True
