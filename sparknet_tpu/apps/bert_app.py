@@ -489,15 +489,9 @@ def _fit_loop(solver, feed, args, timer, primary) -> Dict[str, float]:
                 print(f"Snapshotting solver state to {path}")
         if preempted:
             if primary:
-                tail = (
-                    "snapshot written — relaunch with --auto-resume to "
-                    "continue" if snap_now else
-                    "NO snapshot prefix configured, progress since the "
-                    "last snapshot is lost"
-                )
-                print(
-                    f"SIGTERM: preempted at iteration {solver.iter}; {tail}"
-                )
+                from ..solver.preempt import preempt_message
+
+                print(preempt_message(solver.iter, bool(snap_now)))
             break
     return metrics
 

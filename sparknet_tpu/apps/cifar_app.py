@@ -309,7 +309,7 @@ def train_loop(
         log(f"Snapshotting to {path}")
         log(f"Snapshotting solver state to {state_path}")
 
-    from ..solver.preempt import preemption_grace
+    from ..solver.preempt import preempt_message, preemption_grace
 
     with preemption_grace(solver):
         # Caffe's pre-loop gate (Solver::Step):
@@ -351,17 +351,7 @@ def train_loop(
                 solver.stop_requested = False  # consumed: solver reusable
                 if sp.snapshot_prefix:
                     write_snapshot()
-                    log(
-                        f"SIGTERM: preempted at iteration {solver.iter}; "
-                        f"snapshot written — relaunch with --auto-resume "
-                        f"to continue"
-                    )
-                else:
-                    log(
-                        f"SIGTERM: preempted at iteration {solver.iter}; "
-                        f"NO snapshot_prefix configured, progress since "
-                        f"the last snapshot is lost"
-                    )
+                log(preempt_message(solver.iter, bool(sp.snapshot_prefix)))
                 break
             at_end = solver.iter >= sp.max_iter
             if (

@@ -21,6 +21,18 @@ import signal
 import jax
 
 
+def preempt_message(it: int, snapshot_written: bool) -> str:
+    """The operator-facing preemption line both apps print — one home
+    so the wording (and the loud no-snapshot warning) cannot drift."""
+    tail = (
+        "snapshot written — relaunch with --auto-resume to continue"
+        if snapshot_written
+        else "NO snapshot prefix configured, progress since the last "
+             "snapshot is lost"
+    )
+    return f"SIGTERM: preempted at iteration {it}; {tail}"
+
+
 @contextlib.contextmanager
 def preemption_grace(solver):
     old = None

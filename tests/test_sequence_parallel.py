@@ -171,12 +171,7 @@ def test_ring_flash_matches_full_and_grads(causal):
                  impl="flash", interpret=True)
 
     def run(fn_, q_, k_, v_):
-        return jax.shard_map(
-            lambda a, b_, c, m_: fn_(a, b_, c, kv_mask=m_),
-            mesh=mesh,
-            in_specs=(P(None, None, "sp"),) * 3 + (P(None, "sp"),),
-            out_specs=P(None, None, "sp"), check_vma=False,
-        )(q_, k_, v_, mask_j)
+        return _run_sp(fn_, mesh, q_, k_, v_, mask_j)
 
     out = run(fn, q, k, v)
     ref = mha_reference(q, k, v, causal=causal, kv_mask=mask_j)
