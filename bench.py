@@ -150,33 +150,44 @@ def bench_imagenet(
     )
     compute_dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
     shapes = {"data": (bs, size, size, 3), "label": (bs,)}
-    solver = Solver(
-        sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype,
-        # BENCH_REMAT=1: per-layer remat (HBM-for-FLOPs; lets the deep
-        # nets keep their large batch instead of OOM-halving)
-        remat=os.environ.get("BENCH_REMAT", "0") not in ("", "0"),
-    )
 
     rng = np.random.default_rng(0)
     pipeline_mode = os.environ.get("BENCH_INPUT_PIPELINE", "0")
     end_to_end = pipeline_mode not in ("", "0")
 
+    from sparknet_tpu.data.imagenet import BGR_MEAN
+    from sparknet_tpu.data.preprocess import Transformer
+
+    bench_tf = Transformer(
+        mean_values=list(BGR_MEAN), crop_size=size, mirror=True, train=True
+    )
+    solver = Solver(
+        sp, shapes, solver_dir=zoo, compute_dtype=compute_dtype,
+        # BENCH_REMAT=1: per-layer remat (HBM-for-FLOPs; lets the deep
+        # nets keep their large batch instead of OOM-halving)
+        remat=os.environ.get("BENCH_REMAT", "0") not in ("", "0"),
+        # BENCH_INPUT_PIPELINE=device: augmentation runs inside the
+        # jitted step; the host only ships uint8 + the aug plan
+        batch_transform=(
+            bench_tf.device_fn() if pipeline_mode == "device" else None
+        ),
+    )
+
     def e2e_feed(mode: str):
         """Fresh host batches through the real preprocessing path,
         device-prefetched — the end-to-end feed ImageNetApp trains on."""
         from sparknet_tpu.apps.cifar_app import make_native_feed
-        from sparknet_tpu.apps.imagenet_app import make_feed
-        from sparknet_tpu.data.imagenet import BGR_MEAN, imagenet_dataset
+        from sparknet_tpu.apps.imagenet_app import make_device_feed, make_feed
+        from sparknet_tpu.data.imagenet import imagenet_dataset
         from sparknet_tpu.data.prefetch import prefetch_to_device
-        from sparknet_tpu.data.preprocess import Transformer
 
         ds = imagenet_dataset(None, train=True, synthetic_n=max(2048, 2 * bs))
-        tf = Transformer(
-            mean_values=list(BGR_MEAN), crop_size=size, mirror=True, train=True
-        )
-        # "native" -> C++ threaded prefetch loader; else host-python path
-        make = make_native_feed if mode == "native" else make_feed
-        return prefetch_to_device(make(ds, tf, bs, seed=0), size=2)
+        # "native" -> C++ threaded prefetch loader; "device" -> uint8 +
+        # aug plan, pixels transformed on device; else host-python path
+        make = {
+            "native": make_native_feed, "device": make_device_feed
+        }.get(mode, make_feed)
+        return prefetch_to_device(make(ds, bench_tf, bs, seed=0), size=2)
 
     if end_to_end:
         feed_iter = e2e_feed(pipeline_mode)
@@ -202,8 +213,13 @@ def bench_imagenet(
     except Exception as e:
         # unattended hardware windows must not die on a too-big default
         # batch (VGG-16 activations at bs128 are near the HBM limit):
-        # halve and retry until it fits
-        if "RESOURCE_EXHAUSTED" in str(e) and bs >= 2:
+        # halve and retry until it fits. Two spellings: local PJRT OOM is
+        # RESOURCE_EXHAUSTED, but the axon remote-compile helper wraps the
+        # same failure as INTERNAL with the allocator's prose (observed:
+        # "Ran out of memory in memory space hbm ... Exceeded hbm
+        # capacity" inside a JaxRuntimeError: INTERNAL: HTTP 500).
+        oom = "RESOURCE_EXHAUSTED" in str(e) or "Ran out of memory" in str(e)
+        if oom and bs >= 2:
             oom_retry = True  # retry OUTSIDE the except block: the live
             # exception's traceback pins Solver.step's frame (and with
             # it the solver's device state) until the handler exits

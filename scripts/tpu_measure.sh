@@ -66,9 +66,10 @@ done
 say "bench: bert (flash+fused-qkv default, analytic MFU)"
 BENCH_MODEL=bert run_logged "bench-bert" timeout 600 python bench.py
 
-say "bench: alexnet end-to-end input pipeline (python + native, prefetched)"
+say "bench: alexnet end-to-end input pipeline (python / native / device-augment)"
 BENCH_INPUT_PIPELINE=1 run_logged "e2e-python" timeout 600 python bench.py
 BENCH_INPUT_PIPELINE=native run_logged "e2e-native" timeout 600 python bench.py
+BENCH_INPUT_PIPELINE=device run_logged "e2e-device" timeout 600 python bench.py
 
 say "per-layer alexnet table (the MFU diagnosis)"
 if probe; then

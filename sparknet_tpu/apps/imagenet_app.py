@@ -61,6 +61,26 @@ def make_feed(
     return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
 
 
+def make_device_feed(
+    ds, transformer: Transformer, batch_size: int, seed: int = 0
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Feed for device-side augmentation: yields the raw uint8 source
+    batch plus the augmentation *plan* (crop offsets / flip bits drawn
+    from the same per-batch lineage RNG as :func:`make_feed`); the
+    pixel work happens inside the jitted train step
+    (``Solver(batch_transform=transformer.device_fn())``). Host cost
+    drops to shuffle + memcpy; H2D ships uint8 (~3x smaller than
+    float32 crops)."""
+
+    def transform(batch, rng):
+        data = np.ascontiguousarray(batch["data"])
+        out = {"data": data, "label": np.asarray(batch["label"], np.int32)}
+        out.update(transformer.plan(len(data), data.shape[1:3], rng))
+        return out
+
+    return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
+
+
 def make_args(**overrides) -> argparse.Namespace:
     """Programmatic equivalent of the CLI (tests, notebooks)."""
     args = parser().parse_args([])
@@ -158,19 +178,37 @@ def build(args):
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         remat=getattr(args, "remat", False),
     )
+    device_augment = getattr(args, "device_augment", False)
     if args.parallel == "none":
+        if device_augment:
+            kw["batch_transform"] = train_tf.device_fn()
         solver = Solver(sp, shapes, **kw)
     else:
+        if device_augment:
+            raise ValueError(
+                "--device-augment currently requires --parallel none "
+                "(the parallel solvers build their own train steps)"
+            )
         solver = ParallelSolver(
             sp, shapes, mesh=make_mesh(), mode=args.parallel, tau=args.tau, **kw
         )
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
-    feed_fn = (
-        make_feed
-        if getattr(args, "native_loader", "auto") == "off"
-        else make_native_feed  # auto/on: falls back if the lib won't build
-    )
+    if device_augment:
+        if getattr(args, "native_loader", "auto") == "on":
+            # reject the conflicting pair rather than silently dropping
+            # the explicitly-requested C++ loader (same
+            # can't-believe-it-took-effect policy as ParallelSolver)
+            raise ValueError(
+                "--device-augment and --native-loader on are exclusive: "
+                "device augmentation replaces the loader's host-side "
+                "pixel work (leave --native-loader at auto/off)"
+            )
+        feed_fn = make_device_feed
+    elif getattr(args, "native_loader", "auto") == "off":
+        feed_fn = make_feed
+    else:
+        feed_fn = make_native_feed  # auto/on: falls back if lib won't build
     train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     record_loader_meta(solver, train_feed)
@@ -192,6 +230,10 @@ def parser() -> argparse.ArgumentParser:
                     default="none")
     ap.add_argument("--tau", type=int, default=10,
                     help="local-SGD sync period (the SparkNet τ knob)")
+    ap.add_argument("--device-augment", action="store_true",
+                    help="apply crop/mirror/mean on device inside the "
+                         "jitted step (host ships uint8 + the aug plan); "
+                         "stream-identical to the python feed")
     ap.add_argument("--native-loader", nargs="?", const="on", default="auto",
                     choices=("auto", "on", "off"),
                     help="C++ prefetching data loader: auto (default — "

@@ -8,11 +8,22 @@ compute by the input pipeline), emitting NHWC float32.
 
 TRAIN phase: random crop + random mirror (per Caffe); TEST phase:
 center crop, no mirror.
+
+Device mode (TPU-first redesign of the same semantics): the host only
+draws the augmentation *plan* (:meth:`Transformer.plan` — crop offsets
+and flip bits from the same per-batch RNG stream as the host path) and
+ships the raw uint8 source batch; :meth:`Transformer.device_fn` returns
+a jit-traceable function that applies crop/mirror/mean/scale on device,
+where XLA fuses it into the train step. This cuts host work to a memcpy
+and shrinks the H2D transfer ~3x (uint8 source vs float32 crops) — the
+input-pipeline answer for a chip that outruns any host-side python.
+Both paths produce bit-identical float32 batches given the same RNG
+(tests/test_device_augment.py).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -75,3 +86,89 @@ class Transformer:
             flip = rng.random(len(x)) < 0.5
             x[flip] = x[flip, :, ::-1]
         return x
+
+    def plan(
+        self, n: int, src_hw: Sequence[int], rng: np.random.Generator
+    ) -> Dict[str, np.ndarray]:
+        """Draw the per-image augmentation plan (crop offsets + flip
+        bits) for a batch of ``n`` source images of ``src_hw = (H, W)``.
+
+        Draws in the exact order/shape the host ``__call__`` does, so
+        the same per-batch RNG yields the same augmentation on either
+        path (the lineage property: a batch's augmentation depends only
+        on its (seed, epoch, index), never on which path applies it)."""
+        h, w = int(src_hw[0]), int(src_hw[1])
+        c = self.crop_size
+        out: Dict[str, np.ndarray] = {}
+        if c:
+            if self.train:
+                out["aug_oy"] = rng.integers(0, h - c + 1, n).astype(np.int32)
+                out["aug_ox"] = rng.integers(0, w - c + 1, n).astype(np.int32)
+            else:
+                out["aug_oy"] = np.full(n, (h - c) // 2, np.int32)
+                out["aug_ox"] = np.full(n, (w - c) // 2, np.int32)
+        if self.mirror and self.train:
+            out["aug_flip"] = rng.random(n) < 0.5
+        return out
+
+    def device_fn(self):
+        """A jit-traceable ``fn(batch) -> batch`` applying this
+        transform on device: pops the :meth:`plan` keys, crops/flips the
+        uint8 ``"data"`` via per-image ``dynamic_slice``, then converts
+        to float32 and applies mean/scale (all fused by XLA into the
+        surrounding train step). Elementwise mean/scale commute with
+        crop/mirror, so operating post-crop gives bit-identical float32
+        to the host path while touching ~25%% fewer pixels."""
+        import jax
+        import jax.numpy as jnp
+
+        mean_values = (
+            jnp.asarray(self.mean_values, jnp.float32)
+            if self.mean_values is not None else None
+        )
+        mean_image = (
+            jnp.asarray(self.mean_image, jnp.float32)
+            if self.mean_image is not None else None
+        )
+        scale, crop = float(self.scale), int(self.crop_size)
+
+        def apply(batch):
+            batch = dict(batch)
+            x = batch["data"]
+            oy = batch.pop("aug_oy", None)
+            ox = batch.pop("aug_ox", None)
+            flip = batch.pop("aug_flip", None)
+            ch = x.shape[-1]
+            if crop and oy is not None:
+                def crop1(img, y, x0):
+                    return jax.lax.dynamic_slice(
+                        img, (y, x0, 0), (crop, crop, ch)
+                    )
+
+                x = jax.vmap(crop1)(x, oy, ox)
+                if mean_image is not None:
+                    # host subtracts the full-size mean image pre-crop;
+                    # slicing the mean with the same offsets is the same
+                    def cropm(y, x0):
+                        return jax.lax.dynamic_slice(
+                            mean_image, (y, x0, 0), (crop, crop, ch)
+                        )
+
+                    mean = jax.vmap(cropm)(oy, ox)
+                else:
+                    mean = mean_image
+            else:
+                mean = mean_image
+            x = x.astype(jnp.float32)
+            if mean is not None:
+                x = x - mean
+            if mean_values is not None:
+                x = x - mean_values
+            if scale != 1.0:
+                x = x * scale
+            if flip is not None:
+                x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+            batch["data"] = x
+            return batch
+
+        return apply

@@ -26,6 +26,7 @@ equivalent).
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -621,16 +622,21 @@ class LRN:
             k = 1.0
         ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), padding)
         d = k + scale * ssum
-        # x * d^-beta. A general pow lowers to exp(beta*log(d)) — two
-        # transcendentals (plus more in its VJP) on the VPU for every
-        # element of a conv-sized tensor. The Caffe betas in the zoo are
-        # all dyadic, so build d^-beta from rsqrt/sqrt chains instead.
-        if beta == 0.75:
+        # x * d^-beta. Round-4 rewrote the pow into rsqrt/sqrt chains on
+        # VPU-transcendental theory; the round-5 on-chip A/B (v5e,
+        # AlexNet bs512, 50 timed iters — RESULTS.md "Round-5 A/B")
+        # measured the chain ~2.5 ms/step SLOWER — LRN is HBM-bound,
+        # and the longer chain plus its VJP materialises more conv-sized
+        # temps than it saves in transcendentals. A single pow (and its
+        # single-temp VJP) wins; SPARKNET_LRN_CHAIN=1 keeps the chain
+        # reachable for re-measurement on other topologies.
+        chain = os.environ.get("SPARKNET_LRN_CHAIN", "0") not in ("", "0")
+        if chain and beta == 0.75:
             t = jnp.sqrt(lax.rsqrt(d))  # d^(-1/4)
             inv = t * t * t
-        elif beta == 0.5:
+        elif chain and beta == 0.5:
             inv = lax.rsqrt(d)
-        elif beta == 1.0:
+        elif chain and beta == 1.0:
             inv = 1.0 / d
         else:
             inv = jnp.power(d, -beta)

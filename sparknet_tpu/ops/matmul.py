@@ -25,8 +25,18 @@ dense projections + MLM head (models/bert.py).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+
+# Round-5 on-chip A/B (v5e, AlexNet bs512, 50 timed iters — table in
+# RESULTS.md "Round-5 A/B"): the custom VJP is ~0.8 ms/step FASTER than
+# the default transpose rule (42.66 vs 43.42 ms), confirming the
+# bf16-rate theory, so it stays the default. SPARKNET_MXU_VJP=0 drops
+# to a plain dot (still bf16 operands + f32 accumulation forward) so
+# the comparison stays re-runnable on other models/topologies.
+_USE_VJP = os.environ.get("SPARKNET_MXU_VJP", "1") not in ("", "0")
 
 
 @jax.custom_vjp
@@ -51,6 +61,10 @@ def _bwd(res, g):
 
 
 mxu_dot.defvjp(_fwd, _bwd)
+
+if not _USE_VJP:
+    def mxu_dot(x, w):  # noqa: F811 — measured fallback, see header
+        return jnp.dot(x, w, preferred_element_type=jnp.float32)
 
 
 @jax.custom_vjp
@@ -81,3 +95,9 @@ def _bmm_bwd(res, g):
 
 
 mxu_bmm.defvjp(_bmm_fwd, _bmm_bwd)
+
+if not _USE_VJP:
+    def mxu_bmm(x, w):  # noqa: F811 — measured fallback, see header
+        return jnp.einsum(
+            "bij,bjk->bik", x, w, preferred_element_type=jnp.float32
+        )

@@ -46,6 +46,14 @@ class ParallelSolver(Solver):
         dp_axis: str = DP_AXIS,
         **kw: Any,
     ):
+        if kw.get("batch_transform") is not None:
+            # the parallel modes build their own train steps below,
+            # which would silently drop the transform — reject, per the
+            # base Solver's can't-believe-it-took-effect policy
+            raise ValueError(
+                "batch_transform (device-side augmentation) is not "
+                "supported by ParallelSolver — use the base Solver"
+            )
         super().__init__(solver, input_shapes, **kw)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode

@@ -69,7 +69,9 @@ def accumulate_grads(grad_fn, params, state, micro_stack, rng):
     return mean0(gstack), new_state, mean0(mstack)
 
 
-def make_train_step(net: XLANet, sp: caffe_pb.SolverParameter) -> Callable:
+def make_train_step(
+    net: XLANet, sp: caffe_pb.SolverParameter, batch_transform=None
+) -> Callable:
     """Returns jittable
     ``train_step(params, state, opt_state, batch, it, rng)
        -> (params, state, opt_state, metrics)``.
@@ -77,10 +79,19 @@ def make_train_step(net: XLANet, sp: caffe_pb.SolverParameter) -> Callable:
     ``batch`` may carry a leading micro-batch axis of size
     ``sp.iter_size``: Caffe's gradient accumulation is then a
     ``lax.scan`` over micro-batches inside the same XLA program.
+
+    ``batch_transform`` (e.g. ``Transformer.device_fn()``) runs on the
+    batch inside the jitted program before the net sees it — device-side
+    augmentation that XLA fuses with the step instead of host python.
     """
     grad_fn = make_grad_fn(net)
 
     def train_step(params, state, opt_state, batch, it, rng):
+        if batch_transform is not None:
+            batch = (
+                jax.vmap(batch_transform)(batch)
+                if sp.iter_size > 1 else batch_transform(batch)
+            )
         if sp.iter_size > 1:
             grads, new_state, metrics = accumulate_grads(
                 grad_fn, params, state, batch, rng
@@ -123,6 +134,7 @@ class Solver:
         seed: int = 0,
         model: Any = None,
         remat: bool = False,
+        batch_transform: Optional[Callable] = None,
     ):
         """``model``: any object satisfying the net protocol
         (``init/apply/loss_and_metrics/param_specs/input_names/
@@ -133,6 +145,9 @@ class Solver:
         are rejected so a caller can't believe they took effect.
         """
         self.sp = solver
+        # device-side augmentation hook, train phase only (TEST center
+        # crop is cheap on host and the eval cadence is rare)
+        self.batch_transform = batch_transform
         if model is not None:
             if net_param is not None or test_input_shapes is not None:
                 raise ValueError(
@@ -191,7 +206,8 @@ class Solver:
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         self._train_step = jax.jit(
-            make_train_step(self.train_net, solver), donate_argnums=(0, 1, 2)
+            make_train_step(self.train_net, solver, self.batch_transform),
+            donate_argnums=(0, 1, 2),
         )
         self._eval_step = jax.jit(make_eval_step(self.test_net))
 

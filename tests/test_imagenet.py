@@ -101,6 +101,42 @@ def test_imagenet_app_alexnet_synthetic_step():
     assert np.isfinite(float(m["loss"]))
 
 
+def test_imagenet_app_device_augment_step():
+    """--device-augment: uint8 + aug plan in, augmentation inside the
+    jitted step; same build/step surface as the host path."""
+    from sparknet_tpu.apps import imagenet_app
+
+    solver, train_feed, _ = imagenet_app.build(
+        imagenet_app.make_args(
+            synthetic=True,
+            synthetic_n=32,
+            synthetic_classes=10,
+            batch_size=4,
+            max_iter=2,
+            device_augment=True,
+        )
+    )
+    batch = next(train_feed)
+    assert batch["data"].dtype == np.uint8  # pixels ship raw
+    assert "aug_oy" in batch and "aug_flip" in batch
+    m = solver.step(train_feed, 2)
+    assert np.isfinite(float(m["loss"]))
+    with pytest.raises(ValueError):
+        imagenet_app.build(
+            imagenet_app.make_args(
+                synthetic=True, batch_size=4, device_augment=True,
+                parallel="sync",
+            )
+        )
+    with pytest.raises(ValueError):  # explicit native loader conflicts
+        imagenet_app.build(
+            imagenet_app.make_args(
+                synthetic=True, batch_size=4, device_augment=True,
+                native_loader="on",
+            )
+        )
+
+
 @pytest.mark.slow
 def test_imagenet_app_parallel_local_tau():
     """τ-local-SGD over the 8-device CPU mesh through the app path."""
