@@ -609,6 +609,26 @@ class LRN:
     def apply(lp, params, state, inputs, ctx):
         size, alpha, beta, k, region = LRN._geom(lp)
         x = inputs[0]
+        if (
+            region == "ACROSS_CHANNELS"
+            and x.ndim == 4
+            and x.shape[-1] <= 2048  # C bounds the in-VMEM (C,C) band
+            and jax.default_backend() == "tpu"
+            and os.environ.get("SPARKNET_LRN_PALLAS", "0") not in ("", "0")
+        ):
+            # fused one-pass kernel (ops/lrn.py). OFF by default: the
+            # round-5 on-chip A/B measured it 2x SLOWER end to end
+            # (86 vs 43 ms AlexNet bs512 step) — mid-network XLA
+            # assigns the neighbouring convs exotic layouts (e.g.
+            # batch-minor {0,3,2,1}) and a pallas_call pins row-major
+            # operands, so every LRN pays conv-sized relayout copies
+            # both ways that dwarf the temp-chain saving. Kept
+            # reachable for standalone/row-major contexts.
+            from ..ops.lrn import lrn_nhwc
+
+            return [
+                lrn_nhwc(x, size=size, alpha=alpha, beta=beta, k=k)
+            ], None
         sq = jnp.square(x.astype(jnp.float32))
         half = size // 2
         if region == "ACROSS_CHANNELS":

@@ -1,0 +1,92 @@
+"""Fused Pallas LRN vs the jnp reduce_window oracle (fwd + grads).
+
+The jnp path in nets/layers.py is torch-verified (test_layers); the
+kernel must match it bitwise-closely in f32, including through
+jax.grad, before it may replace it on TPU."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu.nets import layers as L
+from sparknet_tpu.ops.lrn import lrn_nhwc
+from sparknet_tpu.proto.caffe_pb import LayerParameter
+from sparknet_tpu.proto.textformat import parse
+
+
+def _oracle(x, size, alpha, beta, k):
+    lp = LayerParameter.from_message(parse(
+        f'name: "n" type: "LRN" lrn_param {{ local_size: {size} '
+        f"alpha: {alpha} beta: {beta} k: {k} }}"
+    ))
+    (y,), _ = L.LRN.apply(lp, {}, None, [x], None)
+    return y
+
+
+CASES = [
+    # (shape, size, alpha, beta, k)
+    ((2, 5, 5, 96), 5, 1e-4, 0.75, 1.0),   # AlexNet norm1 geometry
+    ((2, 4, 4, 256), 5, 1e-4, 0.75, 1.0),  # AlexNet norm2 channels
+    ((1, 3, 3, 64), 5, 1e-4, 0.75, 2.0),   # GoogLeNet-style k=2
+    ((2, 3, 3, 32), 3, 5e-5, 0.5, 1.0),    # dyadic beta=0.5
+    ((1, 2, 2, 16), 4, 1e-4, 0.9, 1.0),    # even window + general beta
+]
+
+
+@pytest.mark.parametrize("shape,size,alpha,beta,k", CASES)
+def test_forward_matches_oracle(shape, size, alpha, beta, k):
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(0, 2, shape), jnp.float32
+    )
+    y_ref = _oracle(x, size, alpha, beta, k)
+    y = lrn_nhwc(
+        x, size=size, alpha=alpha, beta=beta, k=k, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=2e-6)
+
+
+@pytest.mark.parametrize("shape,size,alpha,beta,k", CASES)
+def test_grad_matches_oracle(shape, size, alpha, beta, k):
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(0, 2, shape), jnp.float32
+    )
+    g = jnp.asarray(np.random.default_rng(2).normal(0, 1, shape), jnp.float32)
+
+    def loss_ref(x):
+        return jnp.sum(_oracle(x, size, alpha, beta, k) * g)
+
+    def loss_ker(x):
+        return jnp.sum(
+            lrn_nhwc(x, size=size, alpha=alpha, beta=beta, k=k,
+                     interpret=True) * g
+        )
+
+    dx_ref = jax.grad(loss_ref)(x)
+    dx = jax.grad(loss_ker)(x)
+    np.testing.assert_allclose(
+        np.asarray(dx), np.asarray(dx_ref), atol=3e-6
+    )
+
+
+def test_bf16_io_keeps_f32_internals():
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(0, 2, (2, 4, 4, 96)), jnp.bfloat16
+    )
+    y = lrn_nhwc(x, size=5, alpha=1e-4, beta=0.75, k=1.0, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    y_ref = _oracle(x, 5, 1e-4, 0.75, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(y_ref, np.float32), atol=2e-2
+    )
+
+
+def test_row_padding_roundtrip():
+    """N*H*W not a block multiple: pad rows are sliced back off."""
+    x = jnp.asarray(
+        np.random.default_rng(4).normal(0, 1, (3, 7, 5, 32)), jnp.float32
+    )
+    y = lrn_nhwc(x, size=5, alpha=1e-4, beta=0.75, k=1.0, interpret=True)
+    y_ref = _oracle(x, 5, 1e-4, 0.75, 1.0)
+    assert y.shape == x.shape
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=2e-6)
