@@ -612,7 +612,8 @@ class LRN:
         if (
             region == "ACROSS_CHANNELS"
             and x.ndim == 4
-            and x.shape[-1] <= 2048  # C bounds the in-VMEM (C,C) band
+            and x.shape[-1] <= 512  # (C,C) f32 band must fit VMEM
+            # alongside the double-buffered row tiles (1 MB at C=512)
             and jax.default_backend() == "tpu"
             and os.environ.get("SPARKNET_LRN_PALLAS", "0") not in ("", "0")
         ):
@@ -629,7 +630,19 @@ class LRN:
             return [
                 lrn_nhwc(x, size=size, alpha=alpha, beta=beta, k=k)
             ], None
-        sq = jnp.square(x.astype(jnp.float32))
+        # The squared/windowed temps follow the net's compute dtype:
+        # under bf16 the conv activations feeding this are already
+        # bf16-rounded, and keeping LRN's conv-sized temp chain at f32
+        # doubles its HBM bytes for ~3 extra digits in d that the
+        # surrounding net can't use. On-chip (v5e, AlexNet bs512) the
+        # bf16 temp chain is worth 5 ms/step: 42.7 -> 37.6 ms, MFU
+        # 0.234 -> 0.266 (RESULTS.md "Round-5 A/B"). f32 nets are
+        # untouched (x is f32); SPARKNET_LRN_F32=1 restores f32 temps
+        # under bf16 for an apples-to-apples numerics comparison.
+        out_dtype = x.dtype
+        if os.environ.get("SPARKNET_LRN_F32", "0") not in ("", "0"):
+            x = x.astype(jnp.float32)
+        sq = jnp.square(x)
         half = size // 2
         if region == "ACROSS_CHANNELS":
             window = (1, 1, 1, size)
@@ -660,7 +673,7 @@ class LRN:
             inv = 1.0 / d
         else:
             inv = jnp.power(d, -beta)
-        return [(x * inv).astype(x.dtype)], None
+        return [(x * inv).astype(out_dtype)], None
 
 
 class Dropout:

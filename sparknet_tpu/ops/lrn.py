@@ -53,8 +53,10 @@ def _band(c: int, a: int, b: int) -> jax.Array:
 
     The channel stencil as a matmul: lane-shifted slices are the slow
     path on the VPU (measured 2x worse than the jnp fallback end to
-    end), while a (rows, C) x (C, C) dot rides the MXU for free —
-    the band is tiny (<=256x256) and lives in VMEM for the whole grid."""
+    end), while a (rows, C) x (C, C) dot rides the MXU for free — the
+    band lives in VMEM for the whole grid (1 MB at the C=512 cap the
+    layer gate enforces, alongside ~6 MB of double-buffered row
+    tiles)."""
     i = jnp.arange(c)[:, None]  # source channel
     j = jnp.arange(c)[None, :]  # output channel
     return ((j - a <= i) & (i <= j + b)).astype(jnp.float32)

@@ -94,6 +94,30 @@ def test_ave_pool_caffe_divisor():
     np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_lrn_bf16_temps_track_f32():
+    """Under a bf16 compute dtype the LRN temp chain runs bf16 (the
+    round-5 bandwidth win); its output must stay within ordinary bf16
+    rounding of the f32 math it replaces."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(2, 6, 6, 96)).astype(np.float32)
+    # alpha ~1 so d deviates far from 1 and the normalization actually
+    # bites — at the zoo's 1e-4 a broken identity path would pass any
+    # loose-tolerance comparison
+    lp = lp_from(
+        'name: "n" type: "LRN" lrn_param { local_size: 5 alpha: 1.0 beta: 0.75 }'
+    )
+    (y32,), _ = L.LRN.apply(lp, {}, None, [jnp.asarray(x)], CTX)
+    (y16,), _ = L.LRN.apply(
+        lp, {}, None, [jnp.asarray(x, jnp.bfloat16)], CTX
+    )
+    assert y16.dtype == jnp.bfloat16
+    # the transform must be a real normalization, not identity
+    assert float(jnp.max(jnp.abs(y32 - jnp.asarray(x)))) > 0.5
+    np.testing.assert_allclose(
+        np.asarray(y16, np.float32), np.asarray(y32), rtol=3e-2, atol=3e-2
+    )
+
+
 def test_lrn_across_channels_vs_torch():
     rng = np.random.default_rng(3)
     x = rng.normal(size=(2, 8, 6, 6)).astype(np.float32)
