@@ -34,8 +34,14 @@ try:  # pallas TPU backend is unavailable on some hosts; import lazily
 except Exception:  # pragma: no cover
     pltpu = None
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512, not 128: the round-5 on-chip block sweep (RESULTS.md) measured
+# 128x128 blocks 2.3x slower at S=512 (BERT shapes) and 1.6x slower at
+# S=8192 — with D=64 heads a 128-row block is a sliver of the MXU and
+# per-grid-step overhead dominates. 512x512 keeps VMEM tiny (the f32
+# score tile is 1 MB) and _resolve_blocks still shrinks to the largest
+# conforming divisor for short or non-conforming sequences.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
 
 
