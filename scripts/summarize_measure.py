@@ -67,7 +67,12 @@ def main() -> None:
     if markers:
         print("\nGaps / failures:")
         for m in markers:
-            print(f"- {m}")
+            # error records can embed multi-KB compiler dumps (the
+            # remote-compile OOM report); one line carries the gist and
+            # the log keeps the full text
+            first = m.splitlines()[0]
+            elided = len(first) > 300 or first != m
+            print(f"- {first[:300]}{'…' if elided else ''}")
 
 
 if __name__ == "__main__":
