@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -123,12 +124,14 @@ def mha_reference_lse(q, k, v, **kw):
 # ---------------------------------------------------------------------------
 
 
-def _dropout_keep(seed, rate, qi, kb, blk_q, blk_k):
+def _dropout_keep(seed, rate, head_id, qi, kb, blk_q, blk_k):
     """Deterministic per-(b,h,q-block,k-block) keep mask; forward and
     both backward kernels regenerate the identical mask from the same
-    coordinates.  Mosaic seeds from at most two scalars, so the grid
-    coordinates fold into them: (seed ⊕ batch/head, q-block ⊕ k-block)."""
-    s1 = seed ^ (pl.program_id(0) * 65536 + pl.program_id(1))
+    coordinates.  Mosaic seeds from at most two scalars, so the
+    coordinates fold into them: (seed ⊕ batch/head, q-block ⊕ k-block).
+    ``head_id`` is the ABSOLUTE head index (grid head-group × fold +
+    in-kernel offset) so the mask is invariant to the fold factor."""
+    s1 = seed ^ (pl.program_id(0) * 65536 + head_id)
     s2 = qi * 65536 + kb
     pltpu.prng_seed(s1, s2)
     # prng_random_bits is declared int32 (uniform over the full 32-bit
@@ -147,21 +150,22 @@ def _dropout_keep(seed, rate, qi, kb, blk_q, blk_k):
 
 def _fwd_kernel(
     off_ref,  # SMEM (3,): [q_offset, kv_offset, dropout_seed]
-    q_ref,    # (1, 1, blk_q, d)
-    k_ref,    # (1, 1, blk_k, d)   — streamed over the last grid dim
-    v_ref,    # (1, 1, blk_k, d)
+    q_ref,    # (1, F, blk_q, d) — F heads folded per grid step
+    k_ref,    # (1, F, blk_k, d)   — streamed over the last grid dim
+    v_ref,    # (1, F, blk_k, d)
     m_ref,    # (1, 8, blk_k) int8 kv mask block (sublane-broadcast: TPU
-              # requires >=8 sublanes per block)
-    o_ref,    # (1, 1, blk_q, d)
-    lse_ref,  # (1, 1, blk_q, 128) f32, lane-replicated
-    acc_s,    # VMEM (blk_q, d) f32 — running numerator
-    m_s,      # VMEM (blk_q, 128) f32 — running max (lane-replicated)
-    l_s,      # VMEM (blk_q, 128) f32 — running denominator
+              # requires >=8 sublanes per block; head-independent)
+    o_ref,    # (1, F, blk_q, d)
+    lse_ref,  # (1, F, blk_q, 128) f32, lane-replicated
+    acc_s,    # VMEM (F, blk_q, d) f32 — running numerator per head
+    m_s,      # VMEM (F, blk_q, 128) f32 — running max (lane-replicated)
+    l_s,      # VMEM (F, blk_q, 128) f32 — running denominator
     *,
     causal: bool,
     scale: float,
     nkb: int,
     dropout_rate: float,
+    fold: int,
 ):
     qi = pl.program_id(2)
     kb = pl.program_id(3)
@@ -177,15 +181,7 @@ def _fwd_kernel(
         l_s[...] = jnp.zeros_like(l_s)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale  # (blk_q, d)
-        k_blk = k_ref[0, 0].astype(jnp.float32)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (blk_q, blk_k)
-        kmask = m_ref[0, 0]  # (blk_k,) int8
-        s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+        kmask = m_ref[0, 0]  # (blk_k,) int8, shared by all heads
         if causal:
             q_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
@@ -195,27 +191,40 @@ def _fwd_kernel(
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
                 + kb * blk_k + kv_offset
             )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        m_prev = m_s[:, 0:1]  # (blk_q, 1) — lanes hold identical values
-        l_prev = l_s[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        # l accumulates the UNdropped mass (softmax normalises before
-        # dropout); only the value accumulation sees the keep mask
-        l_s[...] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_s.shape
-        )
-        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
-        if dropout_rate > 0.0:
-            keep = _dropout_keep(
-                off_ref[2], dropout_rate, qi, kb, blk_q, blk_k
+            causal_keep = k_pos <= q_pos
+        for hh in range(fold):
+            q = q_ref[0, hh].astype(jnp.float32) * scale  # (blk_q, d)
+            k_blk = k_ref[0, hh].astype(jnp.float32)
+            v_blk = v_ref[0, hh].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (blk_q, blk_k)
+            s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+            if causal:
+                s = jnp.where(causal_keep, s, NEG_INF)
+            m_prev = m_s[hh, :, 0:1]  # (blk_q, 1) — lanes identical
+            l_prev = l_s[hh, :, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            # l accumulates the UNdropped mass (softmax normalises
+            # before dropout); only the value accumulation is masked
+            l_s[hh] = jnp.broadcast_to(
+                alpha * l_prev + jnp.sum(p, axis=1, keepdims=True),
+                l_s.shape[1:],
             )
-            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            m_s[hh] = jnp.broadcast_to(m_new, m_s.shape[1:])
+            if dropout_rate > 0.0:
+                keep = _dropout_keep(
+                    off_ref[2], dropout_rate,
+                    pl.program_id(1) * fold + hh, qi, kb, blk_q, blk_k,
+                )
+                p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            acc_s[hh] = acc_s[hh] * alpha + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if causal:
         # blocks fully above the diagonal contribute nothing: skip the
@@ -231,18 +240,19 @@ def _fwd_kernel(
 
     @pl.when(kb == nkb - 1)
     def _finalize():
-        m_i = m_s[:, 0:1]
-        l_i = l_s[:, 0:1]
-        l_safe = jnp.maximum(l_i, 1e-30)
-        # a query row with no valid key (m never rose above NEG_INF)
-        # outputs zero, and its lse stays at NEG_INF so the backward
-        # kernels' masked-p guard zeroes its gradients too
-        dead = m_i <= NEG_INF * 0.5
-        o_ref[0, 0] = jnp.where(
-            dead, 0.0, acc_s[...] / l_safe
-        ).astype(o_ref.dtype)
-        lse = jnp.where(dead, NEG_INF, m_i + jnp.log(l_safe))  # (blk_q, 1)
-        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+        for hh in range(fold):
+            m_i = m_s[hh, :, 0:1]
+            l_i = l_s[hh, :, 0:1]
+            l_safe = jnp.maximum(l_i, 1e-30)
+            # a query row with no valid key (m never rose above
+            # NEG_INF) outputs zero, and its lse stays at NEG_INF so
+            # the backward kernels' masked-p guard zeroes its grads too
+            dead = m_i <= NEG_INF * 0.5
+            o_ref[0, hh] = jnp.where(
+                dead, 0.0, acc_s[hh] / l_safe
+            ).astype(o_ref.dtype)
+            lse = jnp.where(dead, NEG_INF, m_i + jnp.log(l_safe))
+            lse_ref[0, hh] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 # ---------------------------------------------------------------------------
@@ -252,10 +262,11 @@ def _fwd_kernel(
 def _bwd_dq_kernel(
     off_ref, q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_s, *, causal: bool, scale: float, nkb: int,
-    dropout_rate: float,
+    dropout_rate: float, fold: int,
 ):
-    """Grid (b, h, nq, nk): K/V stream over the last dim, dq accumulates
-    in VMEM scratch and is written once on the final k step."""
+    """Grid (b, h/F, nq, nk): K/V stream over the last dim, dq (per
+    folded head) accumulates in VMEM scratch, written on the final k
+    step."""
     qi = pl.program_id(2)
     kb = pl.program_id(3)
     blk_q, d = q_ref.shape[2], q_ref.shape[3]
@@ -267,18 +278,7 @@ def _bwd_dq_kernel(
         dq_s[...] = jnp.zeros_like(dq_s)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0, :, 0:1]    # (blk_q, 1), lane-replicated
-        delta = delta_ref[0, 0, :, 0:1]
-        k_blk = k_ref[0, 0].astype(jnp.float32)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         kmask = m_ref[0, 0]
-        s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
         if causal:
             q_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
@@ -288,24 +288,39 @@ def _bwd_dq_kernel(
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
                 + kb * blk_k + kv_offset
             )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        # masked logits must yield p=0 even when lse is itself NEG_INF
-        # (fully-padded row): exp(NEG_INF - NEG_INF) would be 1
-        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if dropout_rate > 0.0:
-            keep = _dropout_keep(
-                off_ref[2], dropout_rate, qi, kb, blk_q, blk_k
+            causal_keep = k_pos <= q_pos
+        for hh in range(fold):
+            q = q_ref[0, hh].astype(jnp.float32) * scale
+            do = do_ref[0, hh].astype(jnp.float32)
+            lse = lse_ref[0, hh, :, 0:1]    # (blk_q, 1), lane-replicated
+            delta = delta_ref[0, hh, :, 0:1]
+            k_blk = k_ref[0, hh].astype(jnp.float32)
+            v_blk = v_ref[0, hh].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta)
-        dq_s[...] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+            if causal:
+                s = jnp.where(causal_keep, s, NEG_INF)
+            # masked logits must yield p=0 even when lse is itself
+            # NEG_INF (fully-padded row): exp(NEG_INF-NEG_INF) would be 1
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                keep = _dropout_keep(
+                    off_ref[2], dropout_rate,
+                    pl.program_id(1) * fold + hh, qi, kb, blk_q, blk_k,
+                )
+                dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+            ds = p * (dp - delta)
+            dq_s[hh] += jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if causal:
         last_q = qi * blk_q + blk_q - 1 + q_offset
@@ -319,16 +334,18 @@ def _bwd_dq_kernel(
 
     @pl.when(kb == nkb - 1)
     def _finalize():
-        dq_ref[0, 0] = (dq_s[...] * scale).astype(dq_ref.dtype)
+        for hh in range(fold):
+            dq_ref[0, hh] = (dq_s[hh] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     off_ref, q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float, nqb: int,
-    dropout_rate: float,
+    dropout_rate: float, fold: int,
 ):
-    """Grid (b, h, nk, nq): Q/dO/lse/delta stream over the last dim,
-    dk/dv accumulate in VMEM scratch, written once on the final q step."""
+    """Grid (b, h/F, nk, nq): Q/dO/lse/delta stream over the last dim,
+    dk/dv (per folded head) accumulate in VMEM scratch, written once on
+    the final q step."""
     ki = pl.program_id(2)
     qb = pl.program_id(3)
     blk_k, d = k_ref.shape[2], k_ref.shape[3]
@@ -341,18 +358,7 @@ def _bwd_dkv_kernel(
         dv_s[...] = jnp.zeros_like(dv_s)
 
     def compute():
-        k_blk = k_ref[0, 0].astype(jnp.float32)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
         kmask = m_ref[0, 0]
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0, :, 0:1]    # (blk_q, 1), lane-replicated
-        delta = delta_ref[0, 0, :, 0:1]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
         if causal:
             q_pos = (
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
@@ -362,34 +368,50 @@ def _bwd_dkv_kernel(
                 jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
                 + ki * blk_k + kv_offset
             )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        # same masked-p guard as _bwd_dq_kernel (fully-padded rows)
-        p = jnp.where(
-            s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse)
-        )  # (blk_q, blk_k)
-        if dropout_rate > 0.0:
-            # mask coordinates are (q-block, k-block) — matches fwd/dq
-            keep = _dropout_keep(
-                off_ref[2], dropout_rate, qb, ki, blk_q, blk_k
+            causal_keep = k_pos <= q_pos
+        for hh in range(fold):
+            k_blk = k_ref[0, hh].astype(jnp.float32)
+            v_blk = v_ref[0, hh].astype(jnp.float32)
+            q = q_ref[0, hh].astype(jnp.float32) * scale
+            do = do_ref[0, hh].astype(jnp.float32)
+            lse = lse_ref[0, hh, :, 0:1]   # (blk_q, 1), lane-replicated
+            delta = delta_ref[0, hh, :, 0:1]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        else:
-            p_drop = p
-        dv_s[...] += jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if dropout_rate > 0.0:
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta)
-        dk_s[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            s = jnp.where(kmask[None, :] != 0, s, NEG_INF)
+            if causal:
+                s = jnp.where(causal_keep, s, NEG_INF)
+            # same masked-p guard as _bwd_dq_kernel (fully-padded rows)
+            p = jnp.where(
+                s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse)
+            )  # (blk_q, blk_k)
+            if dropout_rate > 0.0:
+                # mask coordinates are (q-block, k-block) — matches
+                # fwd/dq; head id is absolute, fold-invariant
+                keep = _dropout_keep(
+                    off_ref[2], dropout_rate,
+                    pl.program_id(1) * fold + hh, qb, ki, blk_q, blk_k,
+                )
+                p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            else:
+                p_drop = p
+            dv_s[hh] += jax.lax.dot_general(
+                p_drop, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+            ds = p * (dp - delta)
+            dk_s[hh] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if causal:
         # q blocks fully before the diagonal can't see this k block
@@ -405,8 +427,9 @@ def _bwd_dkv_kernel(
     @pl.when(qb == nqb - 1)
     def _finalize():
         # q entered the matmuls pre-scaled, so ds^T @ q carries `scale`
-        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+        for hh in range(fold):
+            dk_ref[0, hh] = dk_s[hh].astype(dk_ref.dtype)
+            dv_ref[0, hh] = dv_s[hh].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -427,41 +450,80 @@ def _params(interpret):
     }
 
 
-def _qk_specs(blk_q, blk_k, d):
-    """in_specs for (offsets, q, k, v, mask) on a (b, h, nq, nk) grid:
-    q indexed by the q-block dim, k/v/mask streamed over the k-block dim.
-    The kv mask arrives sublane-broadcast as (b, 8, sk)."""
+def _fold_heads(h: int, blk_q: int, blk_k: int, d: int) -> int:
+    """Heads folded per grid step (the F in the kernels' (1, F, blk, d)
+    blocks). Folding amortises per-grid-step overhead — the round-5
+    fwd prototype measured ~20 % off wall-clock at BERT shapes — but
+    every folded head multiplies the VMEM working set, so F is the
+    largest divisor of ``h`` whose estimated footprint (double-buffered
+    in AND out blocks + f32 scratch + lse/delta) fits a 14 MB budget
+    (F=4 at BERT shapes ≈ 13.6 MB, compile- and bench-validated on
+    v5e; the margin to the 16 MB VMEM is thin by design — Mosaic's own
+    accounting rejects anything the estimate misses at compile time,
+    not at runtime). SPARKNET_FLASH_FOLD=1 pins F=1 (the pre-fold
+    layout); consulted at trace time — see ``flash_attention(fold=)``
+    for a jit-cache-honest override."""
+    if os.environ.get("SPARKNET_FLASH_FOLD", "") == "1":
+        return 1
+    per = (
+        2 * 2 * (2 * blk_q * d + 2 * blk_k * d)   # bf16 q/do + k/v, 2x buf
+        + 2 * 2 * 4 * blk_q * 128                 # f32 lse+delta in, 2x buf
+        + 4 * (blk_q * d + 2 * blk_q * 128 + 2 * blk_k * d)  # scratch
+        # outputs, 2x buffered: worst of fwd (o bf16 + lse f32) and
+        # dkv (dk+dv bf16) ≈ their sum, kept simple and conservative
+        + 2 * 2 * (blk_q * d + 2 * blk_k * d)
+        + 2 * 4 * blk_q * 128
+    )
+    f = max(1, (14 * 2**20) // per)
+    while h % f:
+        f -= 1
+    return f
+
+
+def _qk_specs(blk_q, blk_k, d, fold):
+    """in_specs for (offsets, q, k, v, mask) on a (b, h/F, nq, nk)
+    grid: q indexed by the q-block dim, k/v/mask streamed over the
+    k-block dim, F heads per step. The kv mask arrives
+    sublane-broadcast as (b, 8, sk) and is head-independent."""
     return [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # offsets (3,)
-        pl.BlockSpec((1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
-        pl.BlockSpec((1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
-        pl.BlockSpec((1, 8, blk_k), lambda b_, h_, i, j: (b_, 0, j)),
+        pl.BlockSpec(
+            (1, fold, blk_q, d), lambda b_, g, i, j: (b_, g, i, 0)
+        ),
+        pl.BlockSpec(
+            (1, fold, blk_k, d), lambda b_, g, i, j: (b_, g, j, 0)
+        ),
+        pl.BlockSpec(
+            (1, fold, blk_k, d), lambda b_, g, i, j: (b_, g, j, 0)
+        ),
+        pl.BlockSpec((1, 8, blk_k), lambda b_, g, i, j: (b_, 0, j)),
     ]
 
 
 def _flash_fwd(
     q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
-    dropout_rate,
+    dropout_rate, fold=None,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nkb = sk // blk_k
-    grid = (b, h, sq // blk_q, nkb)
+    if fold is None:
+        fold = _fold_heads(h, blk_q, blk_k, d)
+    grid = (b, h // fold, sq // blk_q, nkb)
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, nkb=nkb,
-        dropout_rate=dropout_rate,
+        dropout_rate=dropout_rate, fold=fold,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=_qk_specs(blk_q, blk_k, d),
+        in_specs=_qk_specs(blk_q, blk_k, d, fold),
         out_specs=[
             pl.BlockSpec(
-                (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+                (1, fold, blk_q, d), lambda b_, g, i, j: (b_, g, i, 0)
             ),
             pl.BlockSpec(
-                (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, i, 0)
+                (1, fold, blk_q, 128), lambda b_, g, i, j: (b_, g, i, 0)
             ),
         ],
         out_shape=[
@@ -470,9 +532,9 @@ def _flash_fwd(
             jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((blk_q, d), jnp.float32),
-            pltpu.VMEM((blk_q, 128), jnp.float32),
-            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((fold, blk_q, d), jnp.float32),
+            pltpu.VMEM((fold, blk_q, 128), jnp.float32),
+            pltpu.VMEM((fold, blk_q, 128), jnp.float32),
         ],
         **_params(interpret),
     )(offsets, q, k, v, kv_mask)
@@ -480,26 +542,26 @@ def _flash_fwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11)
 )
 def _flash(
     q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
-    dropout_rate,
+    dropout_rate, fold,
 ):
     out, _ = _flash_fwd(
         q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
-        dropout_rate,
+        dropout_rate, fold=fold,
     )
     return out
 
 
 def _flash_vjp_fwd(
     q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
-    dropout_rate,
+    dropout_rate, fold,
 ):
     out, lse = _flash_fwd(
         q, k, v, kv_mask, offsets, causal, scale, blk_q, blk_k, interpret,
-        dropout_rate,
+        dropout_rate, fold=fold,
     )
     # residual keeps one lane of the lane-replicated lse — 1/128th the
     # HBM; the backward broadcasts it back transiently (like delta)
@@ -507,7 +569,7 @@ def _flash_vjp_fwd(
 
 
 def _flash_vjp_bwd(
-    causal, scale, blk_q, blk_k, interpret, dropout_rate, res, do
+    causal, scale, blk_q, blk_k, interpret, dropout_rate, fold, res, do
 ):
     q, k, v, kv_mask, offsets, out, lse = res
     b, h, sq, _ = q.shape
@@ -522,14 +584,14 @@ def _flash_vjp_bwd(
     dq, dk, dv = _flash_bwd(
         q, k, v, kv_mask, offsets, do, lse, delta, causal=causal,
         scale=scale, blk_q=blk_q, blk_k=blk_k, interpret=interpret,
-        dropout_rate=dropout_rate,
+        dropout_rate=dropout_rate, fold=fold,
     )
     return dq, dk, dv, None, None
 
 
 def _flash_bwd(
     q, k, v, kv_mask, offsets, do, lse, delta, *, causal, scale,
-    blk_q, blk_k, interpret, dropout_rate,
+    blk_q, blk_k, interpret, dropout_rate, fold=None,
 ):
     """The two backward pallas calls, reusable per ring block: ``lse``
     and ``delta`` arrive lane-replicated (b, h, sq, 128) and may be the
@@ -539,71 +601,73 @@ def _flash_bwd(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     nqb, nkb = sq // blk_q, sk // blk_k
+    if fold is None:
+        fold = _fold_heads(h, blk_q, blk_k, d)
 
-    # dq: grid (b, h, nq, nk) — K/V streamed, dq carried in scratch
-    dq_specs = _qk_specs(blk_q, blk_k, d) + [
+    # dq: grid (b, h/F, nq, nk) — K/V streamed, dq carried in scratch
+    dq_specs = _qk_specs(blk_q, blk_k, d, fold) + [
         pl.BlockSpec(
-            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            (1, fold, blk_q, d), lambda b_, g, i, j: (b_, g, i, 0)
         ),  # do
         pl.BlockSpec(
-            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, i, 0)
+            (1, fold, blk_q, 128), lambda b_, g, i, j: (b_, g, i, 0)
         ),  # lse
         pl.BlockSpec(
-            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, i, 0)
+            (1, fold, blk_q, 128), lambda b_, g, i, j: (b_, g, i, 0)
         ),  # delta
     ]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, scale=scale, nkb=nkb,
-            dropout_rate=dropout_rate,
+            dropout_rate=dropout_rate, fold=fold,
         ),
-        grid=(b, h, nqb, nkb),
+        grid=(b, h // fold, nqb, nkb),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec(
-            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            (1, fold, blk_q, d), lambda b_, g, i, j: (b_, g, i, 0)
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((fold, blk_q, d), jnp.float32)],
         **_params(interpret),
     )(offsets, q, k, v, kv_mask, do, lse, delta)
 
-    # dkv: grid (b, h, nk, nq) — q/do/lse/delta streamed over q blocks,
-    # dk/dv carried in scratch
+    # dkv: grid (b, h/F, nk, nq) — q/do/lse/delta streamed over q
+    # blocks, dk/dv carried in scratch
     dkv_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec(
-            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, j, 0)
+            (1, fold, blk_q, d), lambda b_, g, i, j: (b_, g, j, 0)
         ),  # q
         pl.BlockSpec(
-            (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            (1, fold, blk_k, d), lambda b_, g, i, j: (b_, g, i, 0)
         ),  # k
         pl.BlockSpec(
-            (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+            (1, fold, blk_k, d), lambda b_, g, i, j: (b_, g, i, 0)
         ),  # v
-        pl.BlockSpec((1, 8, blk_k), lambda b_, h_, i, j: (b_, 0, i)),  # mask
+        pl.BlockSpec((1, 8, blk_k), lambda b_, g, i, j: (b_, 0, i)),  # mask
         pl.BlockSpec(
-            (1, 1, blk_q, d), lambda b_, h_, i, j: (b_, h_, j, 0)
+            (1, fold, blk_q, d), lambda b_, g, i, j: (b_, g, j, 0)
         ),  # do
         pl.BlockSpec(
-            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, j, 0)
+            (1, fold, blk_q, 128), lambda b_, g, i, j: (b_, g, j, 0)
         ),  # lse
         pl.BlockSpec(
-            (1, 1, blk_q, 128), lambda b_, h_, i, j: (b_, h_, j, 0)
+            (1, fold, blk_q, 128), lambda b_, g, i, j: (b_, g, j, 0)
         ),  # delta
     ]
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale, nqb=nqb,
-            dropout_rate=dropout_rate,
+            dropout_rate=dropout_rate, fold=fold,
         ),
-        grid=(b, h, nkb, nqb),
+        grid=(b, h // fold, nkb, nqb),
         in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec(
-                (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+                (1, fold, blk_k, d), lambda b_, g, i, j: (b_, g, i, 0)
             ),
             pl.BlockSpec(
-                (1, 1, blk_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+                (1, fold, blk_k, d), lambda b_, g, i, j: (b_, g, i, 0)
             ),
         ],
         out_shape=[
@@ -611,8 +675,8 @@ def _flash_bwd(
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((blk_k, d), jnp.float32),
-            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((fold, blk_k, d), jnp.float32),
+            pltpu.VMEM((fold, blk_k, d), jnp.float32),
         ],
         **_params(interpret),
     )(offsets, q, k, v, kv_mask, do, lse, delta)
@@ -755,6 +819,7 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
+    fold: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on (B,H,S,D). Any sequence length works:
     non-conforming lengths are zero-padded up to Mosaic's block
@@ -803,9 +868,13 @@ def flash_attention(
             seed,
         ]
     )
+    # fold: explicit heads-per-grid-step override (must divide H).
+    # Passing it here (rather than flipping SPARKNET_FLASH_FOLD after a
+    # trace) keys the jit cache honestly — a different fold is a
+    # different traced argument, so an A/B actually recompiles.
     out = _flash(
         q, k, v, kv_mask, offsets, causal, scale, block_q, block_k,
-        interpret, float(dropout_rate),
+        interpret, float(dropout_rate), fold,
     )
     return out[:, :, :sq] if pad_q else out
 

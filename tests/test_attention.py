@@ -249,3 +249,53 @@ def test_flash_dropout_keep_rate_on_hardware(rate):
 
     dv = jax.grad(loss)(jnp.asarray(rng.normal(size=q.shape), jnp.float32))
     assert abs(float(jnp.mean(dv)) - 1.0) < 0.05
+
+
+@pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="in-kernel dropout PRNG only exists on real TPU hardware",
+)
+def test_flash_dropout_mask_identical_fwd_bwd_on_hardware():
+    """fwd/bwd dropout-mask identity (the statistical keep-rate test
+    cannot see a derivation mismatch — two different masks with the
+    right rate still have the right expectations).  With v = I the
+    forward output IS the dropped probability matrix p~, so dv must
+    equal p~^T @ dO.  The comparison is statistical, not bitwise: the
+    MXU's multi-pass bf16 f32 matmuls leave ~3e-3 noise, so the test
+    asserts the dv error against the EXTRACTED mask is far below the
+    error against the keep-all hypothesis (a mismatched derivation
+    lands at the keep-all error scale).  S == d so the extraction
+    works; h=2 exercises the head-folded path."""
+    b, h, s = 1, 2, 256
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(b, h, s, s)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, s)), jnp.float32)
+    eye = jnp.broadcast_to(jnp.eye(s, dtype=jnp.float32), (b, h, s, s))
+    key = jax.random.PRNGKey(7)
+    rate = 0.3
+
+    p_dropped = np.asarray(
+        flash_attention(q, k, eye, dropout_rate=rate, dropout_rng=key)
+    )  # (b, h, s, s): row i = dropped+rescaled softmax probs of query i
+    p_all = np.asarray(flash_attention(q, k, eye))  # undropped softmax
+
+    g_out = jnp.asarray(rng.normal(size=(b, h, s, s)), jnp.float32)
+
+    def loss(vv):
+        return jnp.sum(
+            flash_attention(q, k, vv, dropout_rate=rate, dropout_rng=key)
+            * g_out
+        )
+
+    dv = np.asarray(jax.grad(loss)(eye))
+    g_np = np.asarray(g_out)
+    err_mask = np.abs(
+        dv - np.einsum("bhqk,bhqd->bhkd", p_dropped, g_np)
+    ).mean()
+    err_keepall = np.abs(
+        dv - np.einsum("bhqk,bhqd->bhkd", p_all, g_np)
+    ).mean()
+    # identical masks: only MXU noise remains; a derivation mismatch
+    # would sit at (or above) the keep-all error scale
+    assert err_mask < 1e-3, err_mask
+    assert err_keepall > 5 * err_mask, (err_mask, err_keepall)
