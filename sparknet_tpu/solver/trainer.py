@@ -38,6 +38,43 @@ def resolve_model_path(path: str, base_dir: str) -> str:
     return path
 
 
+def _step_compiler_options() -> Optional[Dict[str, str]]:
+    """Per-compile XLA options for the single-device train/eval steps.
+
+    ``xla_tpu_scoped_vmem_limit_kib=32768`` measured −3.6 % AlexNet
+    and −6 % BERT step time on v5e end-to-end (size sweep: 24 M no
+    change, 32 M best, 48 M equal, 64 M regresses — more scoped VMEM
+    lets XLA form larger fusions on these bandwidth-bound steps;
+    ResNet-50 loses ~3 %, RESULTS.md "Round-5 A/B"). TPU-only (the
+    option does not exist on other backends); SPARKNET_SCOPED_VMEM_KIB
+    overrides, 0 disables."""
+    if jax.default_backend() != "tpu":
+        return None
+    raw = os.environ.get("SPARKNET_SCOPED_VMEM_KIB", "32768").strip()
+    try:
+        kib = int(raw or "0")
+    except ValueError:
+        raise ValueError(
+            f"SPARKNET_SCOPED_VMEM_KIB must be an integer KiB count "
+            f"(got {raw!r})"
+        )
+    if kib <= 0:
+        return None
+    return {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
+
+
+def jit_with_options(fn, donate_argnums=(), options=None):
+    """``jax.jit`` with per-compile ``compiler_options`` when set.
+
+    (An earlier draft routed through the AOT lower→compile path behind
+    an aval cache; AOT ``Compiled.__call__`` dispatches in Python and
+    measured ~7 ms/step SLOWER than jit's C++ fast path at AlexNet
+    bs512 — jit's own ``compiler_options`` kwarg keeps the fast
+    dispatch.)"""
+    kw = {"compiler_options": options} if options else {}
+    return jax.jit(fn, donate_argnums=donate_argnums, **kw)
+
+
 def make_grad_fn(net: XLANet) -> Callable:
     """``grad_fn(params, state, batch, rng) -> (grads, new_state, metrics)``."""
 
@@ -205,11 +242,14 @@ class Solver:
         self.stop_requested = False
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
-        self._train_step = jax.jit(
+        opts = _step_compiler_options()
+        self._train_step = jit_with_options(
             make_train_step(self.train_net, solver, self.batch_transform),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=(0, 1, 2), options=opts,
         )
-        self._eval_step = jax.jit(make_eval_step(self.test_net))
+        self._eval_step = jit_with_options(
+            make_eval_step(self.test_net), options=opts
+        )
 
     def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
         """Run ``n`` iterations (the reference's ``Solver::Step(n)``).
