@@ -20,7 +20,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nets.xlanet import XLANet
 from ..proto.caffe_pb import SolverParameter
-from ..solver.trainer import make_eval_step, make_train_step
+from ..solver.trainer import (
+    make_eval_step,
+    make_train_step,
+    step_compile_kw,
+)
 from .mesh import DP_AXIS, batch_sharding, replicated
 
 
@@ -46,11 +50,13 @@ def make_dp_train_step(
         bsh = NamedSharding(mesh, P(None, dp_axis))
     else:
         bsh = batch_sharding(mesh, dp_axis)
+    kw = step_compile_kw()
     return jax.jit(
         make_train_step(net, sp),
         in_shardings=(repl, repl, repl, bsh, repl, repl),
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),
+        **kw,
     )
 
 
@@ -61,4 +67,5 @@ def make_dp_eval_step(net: XLANet, mesh: Mesh, dp_axis: str = DP_AXIS) -> Callab
         make_eval_step(net),
         in_shardings=(repl, repl, bsh),
         out_shardings=repl,
+        **step_compile_kw(),
     )

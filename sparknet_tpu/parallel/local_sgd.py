@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..nets.xlanet import XLANet
 from ..proto.caffe_pb import SolverParameter
 from ..solver.caffe_solver import init_opt_state, make_update_fn, mults_for_params
-from ..solver.trainer import accumulate_grads, make_grad_fn
+from ..solver.trainer import accumulate_grads, make_grad_fn, step_compile_kw
 from .mesh import DP_AXIS
 
 
@@ -117,7 +117,9 @@ def make_local_sgd_round(
         in_specs=(P(), P(), P(dp_axis), batch_spec, P(), P()),
         out_specs=(P(), P(), P(dp_axis), P()),
     )
-    return jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ())
+    return jax.jit(
+        fn, donate_argnums=(0, 1, 2) if donate else (), **step_compile_kw()
+    )
 
 
 def stack_round_batches(batch_list):

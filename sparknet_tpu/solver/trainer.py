@@ -63,16 +63,18 @@ def _step_compiler_options() -> Optional[Dict[str, str]]:
     return {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
 
 
-def jit_with_options(fn, donate_argnums=(), options=None):
-    """``jax.jit`` with per-compile ``compiler_options`` when set.
+def step_compile_kw() -> Dict[str, Any]:
+    """Splat-ready ``jax.jit`` kwargs carrying the measured step
+    compiler options — the ONE place the option dict becomes jit
+    kwargs, shared by the single-device Solver and the dp/local-SGD
+    step builders.
 
-    (An earlier draft routed through the AOT lower→compile path behind
-    an aval cache; AOT ``Compiled.__call__`` dispatches in Python and
-    measured ~7 ms/step SLOWER than jit's C++ fast path at AlexNet
-    bs512 — jit's own ``compiler_options`` kwarg keeps the fast
-    dispatch.)"""
-    kw = {"compiler_options": options} if options else {}
-    return jax.jit(fn, donate_argnums=donate_argnums, **kw)
+    (An earlier draft routed through the AOT lower→compile path; AOT
+    ``Compiled.__call__`` dispatches in Python and measured ~7 ms/step
+    SLOWER than jit's C++ fast path at AlexNet bs512 — jit's own
+    ``compiler_options`` kwarg keeps the fast dispatch.)"""
+    opts = _step_compiler_options()
+    return {"compiler_options": opts} if opts else {}
 
 
 def make_grad_fn(net: XLANet) -> Callable:
@@ -242,14 +244,12 @@ class Solver:
         self.stop_requested = False
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
-        opts = _step_compiler_options()
-        self._train_step = jit_with_options(
+        kw = step_compile_kw()
+        self._train_step = jax.jit(
             make_train_step(self.train_net, solver, self.batch_transform),
-            donate_argnums=(0, 1, 2), options=opts,
+            donate_argnums=(0, 1, 2), **kw,
         )
-        self._eval_step = jit_with_options(
-            make_eval_step(self.test_net), options=opts
-        )
+        self._eval_step = jax.jit(make_eval_step(self.test_net), **kw)
 
     def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
         """Run ``n`` iterations (the reference's ``Solver::Step(n)``).
