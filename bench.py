@@ -244,8 +244,12 @@ def bench_imagenet(
 
     # 50 timed iters, not 20: on the tunneled backend the per-dispatch
     # latency inflates short runs ~5% (round-5 A/B measured 20-iter
-    # noise at +-1 ms/step); 50 amortizes it below the noise floor
-    iters = int(os.environ.get("BENCH_ITERS", 50 if platform != "cpu" else 4))
+    # noise at +-1 ms/step); 50 amortizes it below the noise floor.
+    # End-to-end modes step in seconds, not ms — 20 iters keeps each
+    # run inside a sweep section's 600 s budget (the native path is
+    # ~5 s/step through the tunnel on a quiet host, worse contended).
+    default_iters = (20 if end_to_end else 50) if platform != "cpu" else 4
+    iters = int(os.environ.get("BENCH_ITERS", default_iters))
     t0 = time.perf_counter()
     m = solver.step(feed(), iters)
     _fence(m)
