@@ -91,8 +91,8 @@ base_lr: 0.05 momentum: 0.9 lr_policy: 'fixed' max_iter: 10 display: 0
 """
 
 
-def _train(feed, batch_transform, iters=3, bs=8):
-    sp = caffe_pb.load_solver(SOLVER, is_path=False)
+def _train(feed, batch_transform, iters=3, bs=8, solver_text=SOLVER):
+    sp = caffe_pb.load_solver(solver_text, is_path=False)
     net = caffe_pb.load_net(NET, is_path=False)
     solver = Solver(
         sp, {"data": (bs, 8, 8, 3), "label": (bs,)}, net_param=net, seed=3,
@@ -100,6 +100,40 @@ def _train(feed, batch_transform, iters=3, bs=8):
     )
     solver.step(feed, iters)
     return solver.params
+
+
+def test_device_augment_under_iter_size_micro_batching():
+    """Caffe ``iter_size`` gradient accumulation stacks micro-batches
+    on a leading axis; the device transform must vmap over it (the
+    make_train_step branch) and still match the host path exactly."""
+    from sparknet_tpu.apps.imagenet_app import make_device_feed, make_feed
+
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, (64, 12, 12, 3)).astype(np.uint8)
+    labels = rng.integers(0, 5, 64).astype(np.int32)
+    ds = ShardedDataset.from_arrays(
+        {"data": data, "label": labels}, num_partitions=4
+    )
+    tf = Transformer(
+        mean_values=[100.0, 110.0, 120.0], crop_size=8, mirror=True,
+        train=True,
+    )
+    accum = SOLVER + " iter_size: 2"
+    # 2 iters x iter_size 2 = 4 micro-batches through the vmap branch
+    p_host = _train(
+        make_feed(ds, tf, 4, seed=6), None, iters=2, bs=4,
+        solver_text=accum,
+    )
+    p_dev = _train(
+        make_device_feed(ds, tf, 4, seed=6), tf.device_fn(), iters=2,
+        bs=4, solver_text=accum,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        p_host, p_dev,
+    )
 
 
 def test_solver_device_augment_equals_host_path():
