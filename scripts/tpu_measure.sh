@@ -11,6 +11,18 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 LOG="${1:-tpu_measure.log}"
 
+# single-instance lock: two concurrent sweeps contend for the one chip
+# and corrupt each other's timings (observed: a duplicate launch cost a
+# bench section its record). flock on an fd held for the script's life.
+# per-uid path: cross-user exclusion is not the goal (and a fixed
+# world-shared file would EACCES the second user on a shared host)
+LOCK="/tmp/sparknet_tpu_measure.$(id -u).lock"
+exec 9>"$LOCK"
+if ! flock -n 9; then
+  echo "another tpu_measure.sh holds $LOCK; refusing to double-run" >&2
+  exit 1
+fi
+
 probe() {
   timeout 45 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
 }

@@ -383,3 +383,18 @@ def test_step_compile_kw_forwards_to_jit(monkeypatch):
     )
     Solver(sp, {"data": (4, 5), "label": (4,)}, net_param=net)
     assert {"xla_tpu_scoped_vmem_limit_kib": "32768"} in seen
+
+    # bench's per-arch override relies on Solver evaluating the env AT
+    # CONSTRUCTION (eager jit in _finish_init): inside _arch_env the
+    # build must see the override, and the env must restore after. A
+    # refactor deferring jit creation would silently void ARCH_ENV —
+    # this pins the ordering contract.
+    import os
+
+    import bench
+
+    seen.clear()
+    with bench._arch_env("resnet50"):
+        Solver(sp, {"data": (4, 5), "label": (4,)}, net_param=net)
+    assert seen and all(o is None for o in seen), seen
+    assert "SPARKNET_SCOPED_VMEM_KIB" not in os.environ
