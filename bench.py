@@ -43,6 +43,19 @@ import time
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
 
+# Interpreter self-repair: 2026-08-02 the image moved every baked package
+# (jax, numpy, ...) out of /usr/local's site-packages into /opt/venv, but
+# PATH still resolves ``python`` to the stripped /usr/local interpreter.
+# If jax is missing here, re-exec under a venv python that has it so the
+# driver's bare ``python bench.py`` keeps working regardless of PATH.
+try:  # pragma: no cover - environment dependent
+    import jax  # noqa: F401
+except ImportError:  # pragma: no cover
+    for _cand in ("/opt/venv/bin/python", "/opt/venv/bin/python3"):
+        if os.path.exists(_cand) and os.path.realpath(_cand) != os.path.realpath(sys.executable):
+            os.execv(_cand, [_cand] + sys.argv)
+    raise
+
 import numpy as np
 import jax
 import jax.numpy as jnp

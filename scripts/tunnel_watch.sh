@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the axon TPU tunnel until it answers, then fire
+# the full measurement sweep (scripts/tpu_measure.sh) exactly once and
+# exit. Run in the background at session start whenever the tunnel is
+# found dead — the tunnel has come back mid-session in rounds 3-5 and an
+# unattended window must not be wasted (RESULTS.md "tunnel journal").
+#
+#   nohup bash scripts/tunnel_watch.sh >> tunnel_watch.log 2>&1 &
+#
+# Probes every PROBE_INTERVAL (default 300 s) with a 45 s timeout; a
+# single success triggers the sweep. The sweep's own flock prevents a
+# double-run if a human fires it concurrently.
+set -u
+cd "$(dirname "$0")/.."
+PROBE_INTERVAL="${PROBE_INTERVAL:-300}"
+
+if ! python -c "import jax" >/dev/null 2>&1; then
+  for _cand in /opt/venv/bin /usr/local/bin; do
+    if "$_cand/python" -c "import jax" >/dev/null 2>&1; then
+      export PATH="$_cand:$PATH"
+      break
+    fi
+  done
+fi
+
+while true; do
+  if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[tunnel_watch] alive at $(date -u +%FT%TZ); firing tpu_measure.sh"
+    bash scripts/tpu_measure.sh
+    echo "[tunnel_watch] sweep done at $(date -u +%FT%TZ)"
+    exit 0
+  fi
+  echo "[tunnel_watch] dead at $(date -u +%FT%TZ); retry in ${PROBE_INTERVAL}s"
+  sleep "$PROBE_INTERVAL"
+done
