@@ -1,0 +1,12 @@
+# Shared interpreter bootstrap: source from scripts that call ``python``.
+# 2026-08-02 the image moved every baked package (jax, numpy, ...) into
+# /opt/venv while bare python on PATH became a stripped interpreter; put
+# a jax-capable bindir first so ``python`` works again.
+if ! python -c "import jax" >/dev/null 2>&1; then
+  for _cand in /opt/venv/bin /usr/local/bin; do
+    if "$_cand/python" -c "import jax" >/dev/null 2>&1; then
+      export PATH="$_cand:$PATH"
+      break
+    fi
+  done
+fi

@@ -11,18 +11,8 @@ set -u -o pipefail
 cd "$(dirname "$0")/.."
 LOG="${1:-tpu_measure.log}"
 
-# 2026-08-02: the image's baked packages moved to /opt/venv while bare
-# ``python`` on PATH became a stripped interpreter with no jax. Resolve
-# a working interpreter once and prepend its bindir so every ``python``
-# below (and in child scripts) gets jax.
-if ! python -c "import jax" >/dev/null 2>&1; then
-  for _cand in /opt/venv/bin /usr/local/bin; do
-    if "$_cand/python" -c "import jax" >/dev/null 2>&1; then
-      export PATH="$_cand:$PATH"
-      break
-    fi
-  done
-fi
+# cwd is the repo root (cd above)
+. scripts/_python_env.sh
 
 # single-instance lock: two concurrent sweeps contend for the one chip
 # and corrupt each other's timings (observed: a duplicate launch cost a

@@ -14,22 +14,22 @@ set -u
 cd "$(dirname "$0")/.."
 PROBE_INTERVAL="${PROBE_INTERVAL:-300}"
 
-if ! python -c "import jax" >/dev/null 2>&1; then
-  for _cand in /opt/venv/bin /usr/local/bin; do
-    if "$_cand/python" -c "import jax" >/dev/null 2>&1; then
-      export PATH="$_cand:$PATH"
-      break
-    fi
-  done
-fi
+# cwd is the repo root (cd above)
+. scripts/_python_env.sh
 
 while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[tunnel_watch] alive at $(date -u +%FT%TZ); firing tpu_measure.sh"
-    bash scripts/tpu_measure.sh
-    echo "[tunnel_watch] sweep done at $(date -u +%FT%TZ)"
-    exit 0
+    if bash scripts/tpu_measure.sh; then
+      echo "[tunnel_watch] sweep done at $(date -u +%FT%TZ)"
+      exit 0
+    fi
+    # rc!=0: another sweep holds the flock, or the tunnel died between
+    # the probe and the sweep's own probe — keep watching either way so
+    # the unattended window is not silently wasted
+    echo "[tunnel_watch] sweep did not run/finish cleanly at $(date -u +%FT%TZ); continuing watch"
+  else
+    echo "[tunnel_watch] dead at $(date -u +%FT%TZ); retry in ${PROBE_INTERVAL}s"
   fi
-  echo "[tunnel_watch] dead at $(date -u +%FT%TZ); retry in ${PROBE_INTERVAL}s"
   sleep "$PROBE_INTERVAL"
 done

@@ -388,6 +388,9 @@ def parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> Dict[str, float]:
+    from ..tools._common import honor_platform_env
+
+    honor_platform_env()
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     if args.parallel in ("tp", "sp", "pp", "ep"):

@@ -421,6 +421,9 @@ def arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    from ..tools._common import honor_platform_env
+
+    honor_platform_env()
     ap = argparse.ArgumentParser(parents=[arg_parser()],
                                  description="CIFAR-10 training (CifarApp)")
     args = ap.parse_args(argv)

@@ -264,6 +264,9 @@ def parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    from ..tools._common import honor_platform_env
+
+    honor_platform_env()
     args = parser().parse_args(argv)
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)

@@ -247,11 +247,14 @@ class Solver:
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         kw = step_compile_kw()
+        self._train_step_fn = make_train_step(
+            self.train_net, solver, self.batch_transform
+        )
         self._train_step = jax.jit(
-            make_train_step(self.train_net, solver, self.batch_transform),
-            donate_argnums=(0, 1, 2), **kw,
+            self._train_step_fn, donate_argnums=(0, 1, 2), **kw,
         )
         self._eval_step = jax.jit(make_eval_step(self.test_net), **kw)
+        self._scan_step_jits: Dict[int, Callable] = {}
 
     def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
         """Run ``n`` iterations (the reference's ``Solver::Step(n)``).
@@ -286,6 +289,58 @@ class Solver:
                 self._push_loss(metrics)
                 if self.iter % self.sp.display == 0:
                     log_fn(self.iter, self._smoothed(metrics))
+        return metrics
+
+    def scan_steps(self, batch, n: int):
+        """Run ``n`` train iterations on ONE resident batch inside a
+        single compiled dispatch (``lax.scan`` over the train step).
+
+        Benchmarking primitive: a remote backend (the axon tunnel) can
+        cost ~100 ms of round-trip latency PER dispatch when degraded,
+        which swamps a ~40 ms step timed through :meth:`step`'s
+        one-dispatch-per-iteration loop. Scanning all ``n`` iterations
+        into one dispatch pays that latency once, so the measurement
+        reflects the chip. Identical per-iteration work to :meth:`step`
+        (one rng split + the full fwd/bwd/update); the rng stream
+        differs (split on device inside the scan rather than on host),
+        so this is for timing, not for bitwise-reproducible training.
+
+        Returns the LAST iteration's metrics (data-dependent on the
+        whole chain — a ``float()`` of any value fences all ``n``)."""
+        jit = self._scan_step_jits.get(n)
+        if jit is None:
+            def scanned(params, state, opt_state, batch, it0, rng0):
+                def body(carry, i):
+                    params, state, opt_state, rng = carry
+                    rng, step_rng = jax.random.split(rng)
+                    params, state, opt_state, metrics = self._train_step_fn(
+                        params, state, opt_state, batch, it0 + i, step_rng
+                    )
+                    return (params, state, opt_state, rng), metrics
+                (params, state, opt_state, _), ms = jax.lax.scan(
+                    body, (params, state, opt_state, rng0),
+                    jnp.arange(n, dtype=jnp.int32),
+                )
+                last = jax.tree_util.tree_map(lambda x: x[-1], ms)
+                return params, state, opt_state, last
+
+            jit = jax.jit(
+                scanned, donate_argnums=(0, 1, 2), **step_compile_kw()
+            )
+            self._scan_step_jits[n] = jit
+        if self.sp.iter_size > 1:
+            # mirror step()'s micro-batch stacking with iter_size copies
+            # of the one resident batch (same per-iteration work)
+            batch = jax.tree_util.tree_map(
+                lambda x: jnp.stack([x] * self.sp.iter_size), batch
+            )
+        batch = self._put_batch(batch)
+        self.rng, scan_rng = jax.random.split(self.rng)
+        self.params, self.state, self.opt_state, metrics = jit(
+            self.params, self.state, self.opt_state, batch,
+            jnp.asarray(self.iter, jnp.int32), scan_rng,
+        )
+        self.iter += n
         return metrics
 
     def _push_loss(self, metrics) -> None:

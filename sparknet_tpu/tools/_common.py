@@ -11,6 +11,26 @@ from __future__ import annotations
 DATA_SOURCE_TYPES = ("Data", "ImageData", "HDF5Data")
 
 
+def honor_platform_env():
+    """Make ``JAX_PLATFORMS=cpu python -m sparknet_tpu...`` mean CPU.
+
+    The axon register hook overwrites the jax *config* with "axon,cpu"
+    at import time, so the env var alone loses the race — and with a
+    dead tunnel, backend init then hangs indefinitely inside the axon
+    PJRT client instead of falling back. When the user explicitly asked
+    for a non-axon platform via the env var, re-assert it through
+    ``jax.config``, which the hook respects. Call at CLI-main entry,
+    before anything touches a device."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want.split(","):
+        import jax
+
+        if str(getattr(jax.config, "jax_platforms", "") or "") != want:
+            jax.config.update("jax_platforms", want)
+
+
 def find_data_layer(net_param, phase: str):
     """The first on-disk-source data layer of the phase, or None."""
     return next(

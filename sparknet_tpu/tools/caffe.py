@@ -133,6 +133,9 @@ def _device_query(argv: List[str]):
 
 
 def main(argv=None):
+    from ._common import honor_platform_env
+
+    honor_platform_env()
     argv = list(sys.argv[1:] if argv is None else argv)
     cmds = {
         "train": _train,

@@ -61,6 +61,9 @@ def compare_trees(a, b):
 
 
 def main(argv=None) -> int:
+    from ._common import honor_platform_env
+
+    honor_platform_env()
     from ..apps import cifar_app
 
     ap = argparse.ArgumentParser(

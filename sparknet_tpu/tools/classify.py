@@ -73,6 +73,9 @@ def classify(net, params, state, batch_hwc: np.ndarray, top_k: int = 5):
 
 
 def main(argv=None):
+    from ._common import honor_platform_env
+
+    honor_platform_env()
     ap = argparse.ArgumentParser(description="deploy-net image classification")
     ap.add_argument("--model", required=True, help="deploy .prototxt")
     ap.add_argument("--weights", default=None, help=".caffemodel")

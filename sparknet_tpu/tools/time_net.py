@@ -88,12 +88,25 @@ def time_solver(solver, shapes, iters: int = 50, warmup: int = 3):
     return out
 
 
-def time_per_layer(net, params, state, batch, iters: int = 10):
+def time_per_layer(net, params, state, batch, iters: int = 10,
+                   scan_iters: int = 0):
     """Per-layer forward/backward timings, like ``caffe time``'s layer
     table: each layer's ``apply`` is jitted and timed in isolation on
     its real input blobs (captured from one full forward), and its
-    backward as the VJP w.r.t. inputs+params at the same point."""
+    backward as the VJP w.r.t. inputs+params at the same point.
+
+    ``scan_iters > 0`` amortises per-dispatch latency: the layer runs
+    ``scan_iters`` times inside ONE jitted ``lax.scan`` dispatch, so a
+    remote backend whose every call costs ~25 ms round-trip (the axon
+    tunnel — RESULTS.md voided the r05 per-layer ms columns over it)
+    still yields real per-iteration numbers. A tiny data-dependent
+    carry (sum(outputs) * 1e-38 added to the float inputs) threads the
+    iterations so XLA can neither hoist the layer out of the loop nor
+    dead-code-eliminate its outputs; the added cost is one read-pass
+    over each output per iteration, negligible for compute-bound layers
+    and a bounded (~one-pass) bias for bandwidth-bound ones."""
     from ..nets.layers import DATA_LAYER_TYPES, LAYER_IMPLS, ApplyCtx
+    from jax import lax
 
     blobs = dict(batch)
     rows = []
@@ -116,16 +129,46 @@ def time_per_layer(net, params, state, batch, iters: int = 10):
             outs, _ = impl.apply(lp, p_, st, inputs_, ctx)
             return outs
 
+        fidx_all = [
+            i for i, x in enumerate(inputs)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+        ]
+
+        def _scan_time(run_once, n):
+            """ms/iter for ``carry -> carry`` run inside one scanned jit
+            dispatch (n iterations, one round-trip)."""
+            def scanned(c0):
+                def body(c, _):
+                    return run_once(c), None
+                c, _ = lax.scan(body, c0, None, length=n)
+                return c
+            jf = jax.jit(scanned).lower(jnp.float32(0.0)).compile()
+            jax.block_until_ready(jf(jnp.float32(0.0)))  # warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(jf(jnp.float32(0.0)))
+            return 1000 * (time.perf_counter() - t0) / n
+
         # compile ONCE (AOT) and use the executable for both the timing
         # loop and cost analysis
         jfwd = jax.jit(fwd).lower(p, inputs).compile()
         outs = jfwd(p, inputs)
         jax.block_until_ready(outs)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            outs = jfwd(p, inputs)
-        jax.block_until_ready(outs)
-        fwd_ms = 1000 * (time.perf_counter() - t0) / iters
+        fwd_scanned = bool(scan_iters and fidx_all and outs)
+        if fwd_scanned:
+            def fwd_once(carry):
+                inputs_ = list(inputs)
+                for i in fidx_all:
+                    inputs_[i] = inputs[i] + carry.astype(inputs[i].dtype)
+                outs_ = fwd(p, inputs_)
+                s = sum(jnp.sum(o.astype(jnp.float32)) for o in outs_)
+                return s * jnp.float32(1e-38)
+            fwd_ms = _scan_time(fwd_once, scan_iters)
+        else:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                outs = jfwd(p, inputs)
+            jax.block_until_ready(outs)
+            fwd_ms = 1000 * (time.perf_counter() - t0) / iters
 
         # cost analysis separates compute-bound from HBM-bound layers:
         # arithmetic intensity = FLOPs / bytes accessed (a layer far
@@ -138,6 +181,7 @@ def time_per_layer(net, params, state, batch, iters: int = 10):
         gbyte = by / 1e9 if by else None
 
         bwd_ms = None
+        bwd_scanned = False
         # float outputs only: losses/metrics and feature maps; index
         # outputs (ArgMax) and no-output layers (Silence) have no VJP
         if outs and all(jnp.issubdtype(o.dtype, jnp.floating) for o in outs):
@@ -154,23 +198,43 @@ def time_per_layer(net, params, state, batch, iters: int = 10):
                 return sum(jnp.sum(o.astype(jnp.float32)) for o in outs_)
 
             if p or fidx:
-                jbwd = jax.jit(jax.grad(scalar, argnums=(0, 1)))
-                finputs = [inputs[i] for i in fidx]
-                g = jbwd(p, finputs)
-                jax.block_until_ready(g)
-                t0 = time.perf_counter()
-                for _ in range(iters):
+                grad_fn = jax.grad(scalar, argnums=(0, 1))
+                bwd_scanned = bool(scan_iters and fidx)
+                if bwd_scanned:
+                    def bwd_once(carry):
+                        finputs_ = [
+                            inputs[i] + carry.astype(inputs[i].dtype)
+                            for i in fidx
+                        ]
+                        g_ = grad_fn(p, finputs_)
+                        s = sum(
+                            jnp.sum(leaf.astype(jnp.float32))
+                            for leaf in jax.tree_util.tree_leaves(g_)
+                        )
+                        return s * jnp.float32(1e-38)
+                    bwd_ms = _scan_time(bwd_once, scan_iters)
+                else:
+                    jbwd = jax.jit(grad_fn)
+                    finputs = [inputs[i] for i in fidx]
                     g = jbwd(p, finputs)
-                jax.block_until_ready(g)
-                bwd_ms = 1000 * (time.perf_counter() - t0) / iters
+                    jax.block_until_ready(g)
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        g = jbwd(p, finputs)
+                    jax.block_until_ready(g)
+                    bwd_ms = 1000 * (time.perf_counter() - t0) / iters
 
-        rows.append((lp.name, lp.type, fwd_ms, bwd_ms, gflop, gbyte))
+        rows.append((lp.name, lp.type, fwd_ms, bwd_ms, gflop, gbyte,
+                     fwd_scanned, bwd_scanned))
         for top, out in zip(lp.top, outs):
             blobs[top] = out
     return rows
 
 
 def main(argv=None):
+    from ._common import honor_platform_env
+
+    honor_platform_env()
     from ..proto import caffe_pb
     from ..solver.trainer import Solver
 
@@ -184,6 +248,10 @@ def main(argv=None):
     ap.add_argument("--per-layer", action="store_true",
                     help="also print per-layer forward/backward ms "
                          "(caffe time's layer table)")
+    ap.add_argument("--scan", type=int, default=0, metavar="N",
+                    help="per-layer mode: run each layer N times inside "
+                         "ONE scanned jit dispatch so remote-dispatch "
+                         "latency amortises (use over the axon tunnel)")
     args = ap.parse_args(argv)
 
     sp = caffe_pb.load_solver(args.solver)
@@ -217,24 +285,35 @@ def main(argv=None):
         batch = synth_batch(shapes)
         rows = time_per_layer(
             solver.train_net, solver.params, solver.state, batch,
-            iters=max(3, args.iters // 5),
+            iters=max(3, args.iters // 5), scan_iters=args.scan,
         )
         print(f"{'layer':<28}{'type':<22}{'fwd ms':>10}{'bwd ms':>10}"
               f"{'GFLOP':>9}{'GB':>8}{'F/B':>7}")
-        for name, ltype, fwd_ms, bwd_ms, gflop, gbyte in rows:
-            b = f"{bwd_ms:.3f}" if bwd_ms is not None else "-"
+        fell_back = False
+        for name, ltype, fwd_ms, bwd_ms, gflop, gbyte, fsc, bsc in rows:
+            # '*' marks a dispatch-per-iteration fallback row when --scan
+            # was requested (int-only inputs etc.): its ms include the
+            # remote round-trip latency the scanned rows amortise away
+            fmark = "*" if args.scan and not fsc else ""
+            f = f"{fwd_ms:.3f}{fmark}"
+            bmark = "*" if args.scan and bwd_ms is not None and not bsc else ""
+            b = f"{bwd_ms:.3f}{bmark}" if bwd_ms is not None else "-"
+            fell_back = fell_back or bool(fmark or bmark)
             gf = f"{gflop:.2f}" if gflop is not None else "-"
             gb = f"{gbyte:.3f}" if gbyte is not None else "-"
             ai = (f"{gflop / gbyte:.0f}"
                   if gflop is not None and gbyte else "-")
-            print(f"{name:<28}{ltype:<22}{fwd_ms:>10.3f}{b:>10}"
+            print(f"{name:<28}{ltype:<22}{f:>10}{b:>10}"
                   f"{gf:>9}{gb:>8}{ai:>7}")
+        if fell_back:
+            print("(*) not scan-amortised — includes per-dispatch latency")
         out["per_layer"] = [
             {"layer": n, "type": t, "forward_ms": round(f, 3),
              "backward_ms": None if b is None else round(b, 3),
              "gflop": None if gf is None else round(gf, 3),
-             "gbytes": None if gb is None else round(gb, 4)}
-            for n, t, f, b, gf, gb in rows
+             "gbytes": None if gb is None else round(gb, 4),
+             **({"scanned": {"fwd": fsc, "bwd": bsc}} if args.scan else {})}
+            for n, t, f, b, gf, gb, fsc, bsc in rows
         ]
     return out
 
