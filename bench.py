@@ -462,6 +462,11 @@ def bench_bert(platform: str) -> dict:
 
 
 def main() -> None:
+    # an explicit JAX_PLATFORMS=cpu must not be overridden by the axon
+    # register hook's "axon,cpu" config (and must skip the 90 s probe)
+    from sparknet_tpu.tools._common import honor_platform_env
+
+    honor_platform_env()
     platform = _first_device().platform
     mode = os.environ.get("BENCH_MODEL", "alexnet")
     profile_dir = os.environ.get("BENCH_PROFILE")

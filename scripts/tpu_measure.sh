@@ -86,18 +86,28 @@ BENCH_INPUT_PIPELINE=1 run_logged "e2e-python" timeout 600 python bench.py
 BENCH_INPUT_PIPELINE=native run_logged "e2e-native" timeout 600 python bench.py
 BENCH_INPUT_PIPELINE=device run_logged "e2e-device" timeout 600 python bench.py
 
-say "per-layer alexnet table (the MFU diagnosis)"
-if probe; then
-  if ! timeout 600 python -m sparknet_tpu.tools.time_net \
-      --solver sparknet_tpu/models/prototxt/bvlc_alexnet_solver.prototxt \
-      --batch-size 256 --iters 10 --bf16 --per-layer \
-      2>>"$LOG.err" | tee -a "$LOG"; then
-    # pipefail: a python failure (not tee's) lands here
-    echo "FAILED(per-layer) — see $LOG.err" | tee -a "$LOG"
+# per_layer <label> <solver> <extra args...>: scan-amortised layer
+# table (--scan 32 packs 32 runs of each layer into one dispatch, so
+# the ms columns are real even over the tunnel's ~25 ms/dispatch
+# latency — the r05 table's timing columns were voided by it)
+per_layer() {
+  local label="$1" solver="$2"; shift 2
+  if probe; then
+    if ! timeout 600 python -m sparknet_tpu.tools.time_net \
+        --solver "$solver" --iters 10 --bf16 --per-layer --scan 32 "$@" \
+        2>>"$LOG.err" | tee -a "$LOG"; then
+      # pipefail: a python failure (not tee's) lands here
+      echo "FAILED(per-layer-$label) — see $LOG.err" | tee -a "$LOG"
+    fi
+  else
+    echo "TUNNEL-DEAD before per-layer-$label" | tee -a "$LOG"
   fi
-else
-  echo "TUNNEL-DEAD before per-layer" | tee -a "$LOG"
-fi
+}
+
+say "per-layer alexnet table (the MFU diagnosis)"
+per_layer alexnet \
+  sparknet_tpu/models/prototxt/bvlc_alexnet_solver.prototxt \
+  --batch-size 256
 
 say "flash dropout keep-rate (hardware-gated regression test)"
 if probe; then
@@ -126,5 +136,12 @@ EOF
 else
   echo "TUNNEL-DEAD before flash-pad-32k" | tee -a "$LOG"
 fi
+
+# LAST on purpose: ~140 layers x several remote compiles each can eat
+# the whole 600 s budget — it must never starve the short sections
+say "per-layer googlenet table (MFU diagnosis for the 0.21 outlier)"
+per_layer googlenet \
+  sparknet_tpu/models/prototxt/bvlc_googlenet_quick_solver.prototxt \
+  --batch-size 128
 
 say "done ($(date -u +%FT%TZ))"

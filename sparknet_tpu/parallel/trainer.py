@@ -115,6 +115,18 @@ class ParallelSolver(Solver):
             raise ValueError(f"mode {mode!r} (want 'sync' or 'local')")
 
     # ------------------------------------------------------------------
+    def scan_steps(self, batch, n: int):
+        """Not supported: the base implementation scans the
+        SINGLE-DEVICE train step, which would silently bypass this
+        solver's dp/local-SGD program (and local mode's per-worker
+        opt_state layout). Local-SGD rounds are already one compiled
+        scan over tau steps — bench parallel modes through step()."""
+        raise NotImplementedError(
+            "ParallelSolver.scan_steps: use step(); local-SGD rounds "
+            "already run as one compiled scan over tau iterations"
+        )
+
+    # ------------------------------------------------------------------
     def _put_batch(self, batch, train: bool = True):
         """sync mode: jit's in_shardings place single-host batches; with
         multiple processes each host contributes only its local rows, so

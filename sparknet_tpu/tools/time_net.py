@@ -185,10 +185,7 @@ def time_per_layer(net, params, state, batch, iters: int = 10,
         # float outputs only: losses/metrics and feature maps; index
         # outputs (ArgMax) and no-output layers (Silence) have no VJP
         if outs and all(jnp.issubdtype(o.dtype, jnp.floating) for o in outs):
-            fidx = [
-                i for i, x in enumerate(inputs)
-                if jnp.issubdtype(x.dtype, jnp.floating)
-            ]
+            fidx = fidx_all
 
             def scalar(p_, finputs):
                 full = list(inputs)
