@@ -128,6 +128,26 @@ def _fence(m) -> None:
     float(next(iter(m.values())))
 
 
+def _dispatch_ms(n: int = 30) -> float | None:
+    """Per-dispatch round-trip latency of the live backend: a chain of
+    trivial jitted calls, each data-dependent on the last. On a local
+    chip this is ~0.1 ms; over the axon tunnel it is the per-iteration
+    tax a dispatch-per-step loop pays (observed 25→110 ms as the link
+    degrades), which is why the headline timing scans instead. Reported
+    so a record carries its own link-quality context."""
+    try:
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.zeros((), jnp.int32)
+        f(x).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = f(x)
+        x.block_until_ready()
+        return round(1000 * (time.perf_counter() - t0) / n, 3)
+    except Exception:
+        return None
+
+
 def _scan_enabled(platform: str) -> bool:
     """Compute-only accelerator timing defaults to ONE scanned dispatch
     for all iters: a degraded tunnel costs ~100 ms round-trip PER
@@ -485,6 +505,8 @@ def main() -> None:
             out = runner(platform)
     else:
         out = runner(platform)
+    if platform != "cpu":
+        out["dispatch_ms"] = _dispatch_ms()
     if _PROBE_NOTE:
         out["backend_probe"] = _PROBE_NOTE
     print(json.dumps(out))
