@@ -129,12 +129,16 @@ def _fence(m) -> None:
 
 
 def _dispatch_ms(n: int = 30) -> float | None:
-    """Per-dispatch round-trip latency of the live backend: a chain of
-    trivial jitted calls, each data-dependent on the last. On a local
-    chip this is ~0.1 ms; over the axon tunnel it is the per-iteration
-    tax a dispatch-per-step loop pays (observed 25→110 ms as the link
-    degrades), which is why the headline timing scans instead. Reported
-    so a record carries its own link-quality context."""
+    """Per-dispatch round-trip latency of the live backend: trivial
+    jitted calls, each fenced by a device->host scalar fetch. On a
+    local chip this is ~0.1 ms; over the axon tunnel it is the
+    per-iteration tax a dispatch-per-step loop pays (observed 25→110 ms
+    as the link degrades), which is why the headline timing scans
+    instead. The host fetch INSIDE the loop is load-bearing: JAX
+    dispatch is async, so a chain of enqueues without a per-iteration
+    sync measures device execution on backends with non-blocking
+    enqueue, and the recorded link-quality context would read healthy
+    over a degraded link (ADVICE r05 #1)."""
     try:
         f = jax.jit(lambda x: x + 1)
         x = jnp.zeros((), jnp.int32)
@@ -142,7 +146,7 @@ def _dispatch_ms(n: int = 30) -> float | None:
         t0 = time.perf_counter()
         for _ in range(n):
             x = f(x)
-        x.block_until_ready()
+            int(x)  # real host-device round-trip every iteration
         return round(1000 * (time.perf_counter() - t0) / n, 3)
     except Exception:
         return None

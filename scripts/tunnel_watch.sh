@@ -13,6 +13,7 @@
 set -u
 cd "$(dirname "$0")/.."
 PROBE_INTERVAL="${PROBE_INTERVAL:-300}"
+SWEEP_LOG="${SWEEP_LOG:-tpu_measure.log}"
 
 # cwd is the repo root (cd above)
 . scripts/_python_env.sh
@@ -20,14 +21,26 @@ PROBE_INTERVAL="${PROBE_INTERVAL:-300}"
 while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[tunnel_watch] alive at $(date -u +%FT%TZ); firing tpu_measure.sh"
-    if bash scripts/tpu_measure.sh; then
-      echo "[tunnel_watch] sweep done at $(date -u +%FT%TZ)"
-      exit 0
+    # remember where this sweep's log section starts: tpu_measure.sh
+    # exits 0 even when the tunnel dies after the first section (later
+    # sections just append TUNNEL-DEAD/FAILED markers), so rc alone
+    # cannot distinguish a complete sweep from a wasted window
+    before=0
+    [ -f "$SWEEP_LOG" ] && before=$(wc -l < "$SWEEP_LOG")
+    if bash scripts/tpu_measure.sh "$SWEEP_LOG"; then
+      if tail -n +"$((before + 1))" "$SWEEP_LOG" 2>/dev/null \
+          | grep -qE 'TUNNEL-DEAD|FAILED\('; then
+        echo "[tunnel_watch] sweep exited 0 but logged TUNNEL-DEAD/FAILED sections at $(date -u +%FT%TZ); continuing watch"
+      else
+        echo "[tunnel_watch] sweep done at $(date -u +%FT%TZ)"
+        exit 0
+      fi
+    else
+      # rc!=0: another sweep holds the flock, or the tunnel died between
+      # the probe and the sweep's own probe — keep watching either way so
+      # the unattended window is not silently wasted
+      echo "[tunnel_watch] sweep did not run/finish cleanly at $(date -u +%FT%TZ); continuing watch"
     fi
-    # rc!=0: another sweep holds the flock, or the tunnel died between
-    # the probe and the sweep's own probe — keep watching either way so
-    # the unattended window is not silently wasted
-    echo "[tunnel_watch] sweep did not run/finish cleanly at $(date -u +%FT%TZ); continuing watch"
   else
     echo "[tunnel_watch] dead at $(date -u +%FT%TZ); retry in ${PROBE_INTERVAL}s"
   fi

@@ -1,0 +1,43 @@
+"""Inference serving subsystem — the training stack's other half.
+
+The reference (and this repo until now) stops at training: a trained
+snapshot could only be exercised by one-shot, compile-per-invocation
+tools. ``serve`` turns any zoo prototxt + snapshot into a persistent
+engine behind a batched request queue:
+
+- :class:`~sparknet_tpu.serve.engine.InferenceEngine` — weights loaded
+  once, ``XLANet.apply`` AOT-compiled per batch-size bucket, requests
+  padded up to the nearest bucket.
+- :class:`~sparknet_tpu.serve.batcher.MicroBatcher` — thread-safe
+  dynamic micro-batching (max-batch / max-latency knobs, bounded-queue
+  backpressure, graceful drain).
+- :class:`~sparknet_tpu.serve.metrics.ServeMetrics` — per-bucket
+  counters, latency histograms, queue-depth / padding-waste gauges,
+  dumpable as one JSON line (bench.py record discipline).
+- :class:`~sparknet_tpu.serve.server.InferenceServer` /
+  :class:`~sparknet_tpu.serve.server.Client` — stdlib HTTP front end
+  (``/classify``, ``/healthz``, ``/metrics``) plus the in-process
+  client tests and load generators drive.
+- :func:`~sparknet_tpu.serve.loadgen.run_loadgen` — offline
+  closed-loop load generator (``serve --bench``), the requests/s and
+  p99 record BENCH tracks alongside training img/s.
+
+See docs/SERVING.md for the architecture and knob reference.
+"""
+
+from .batcher import Backpressure, MicroBatcher
+from .engine import InferenceEngine
+from .loadgen import run_loadgen
+from .metrics import LatencyHistogram, ServeMetrics
+from .server import Client, InferenceServer
+
+__all__ = [
+    "Backpressure",
+    "Client",
+    "InferenceEngine",
+    "InferenceServer",
+    "LatencyHistogram",
+    "MicroBatcher",
+    "ServeMetrics",
+    "run_loadgen",
+]

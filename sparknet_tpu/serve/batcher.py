@@ -1,0 +1,157 @@
+"""MicroBatcher — dynamic micro-batching between requests and engine.
+
+Requests arrive one-at-a-time with a few rows each; the engine is
+fastest fed full buckets. The batcher sits between: a bounded
+thread-safe queue feeds a single worker thread that coalesces queued
+requests until either ``max_batch`` rows are gathered or the oldest
+request has waited ``max_latency_us`` — the classic throughput/latency
+dial. The bounded queue is the backpressure surface: when it is full,
+``submit`` fails fast with :class:`Backpressure` (the HTTP layer maps
+it to 503) instead of letting latency grow without bound.
+
+A single worker thread is deliberate: the engine serializes on one
+device anyway, and one consumer keeps request ordering FIFO.
+``drain()`` stops intake, lets the worker finish everything queued,
+and joins it — the graceful-shutdown path the server and the load
+generator both use.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+
+class Backpressure(RuntimeError):
+    """Raised by submit() when the bounded request queue is full."""
+
+
+class _Pending:
+    __slots__ = ("rows", "n", "future", "t_enq")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.n = len(rows)
+        self.future: Future = Future()
+        self.t_enq = time.perf_counter()
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: int = 0,
+        max_latency_us: int = 2000,
+        max_queue: int = 256,
+        metrics=None,
+    ):
+        """``engine``: anything with ``infer(rows) -> rows`` (the
+        InferenceEngine; tests substitute stubs). ``max_batch``: row
+        budget per engine call — defaults to the engine's largest
+        bucket. ``max_latency_us``: longest the oldest queued request
+        waits for co-riders before the batch is flushed anyway.
+        ``max_queue``: bound on queued requests (backpressure)."""
+        self.engine = engine
+        self.max_batch = int(max_batch) or max(
+            getattr(engine, "buckets", (32,))
+        )
+        self.max_latency_s = max_latency_us / 1e6
+        self.metrics = metrics
+        self._q: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
+        self._open = True
+        self._worker = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        rows,
+        *,
+        block: bool = False,
+        timeout: Optional[float] = None,
+    ) -> Future:
+        """Enqueue one request of N rows; resolves to the engine output
+        for exactly those rows. ``block=False`` (the server's mode)
+        raises :class:`Backpressure` when the queue is full; closed-loop
+        clients pass ``block=True`` to wait for room instead."""
+        if not self._open:
+            raise RuntimeError("MicroBatcher is drained/closed")
+        item = _Pending(np.asarray(rows))
+        if item.n == 0:
+            raise ValueError("submit: empty request")
+        try:
+            self._q.put(item, block=block, timeout=timeout)
+        except queue.Full:
+            raise Backpressure(
+                f"request queue full ({self._q.maxsize} pending)"
+            ) from None
+        if self.metrics is not None:
+            self.metrics.set_queue_depth(self._q.qsize())
+        return item.future
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if not self._open:
+                    return
+                continue
+            batch: List[_Pending] = [first]
+            total = first.n
+            deadline = time.perf_counter() + self.max_latency_s
+            while total < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(item)
+                total += item.n
+            if self.metrics is not None:
+                self.metrics.set_queue_depth(self._q.qsize())
+            self._run(batch, total)
+
+    def _run(self, batch: List[_Pending], total: int) -> None:
+        try:
+            if len(batch) == 1:
+                out = self.engine.infer(batch[0].rows)
+            else:
+                out = self.engine.infer(
+                    np.concatenate([it.rows for it in batch])
+                )
+        except Exception as e:
+            if self.metrics is not None:
+                self.metrics.record_error(len(batch))
+            for it in batch:
+                if not it.future.cancelled():
+                    it.future.set_exception(e)
+            return
+        now = time.perf_counter()
+        ofs = 0
+        for it in batch:
+            if not it.future.cancelled():
+                it.future.set_result(out[ofs : ofs + it.n])
+            ofs += it.n
+            if self.metrics is not None:
+                self.metrics.record_request(now - it.t_enq, rows=it.n)
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout: Optional[float] = 30.0) -> None:
+        """Graceful shutdown: refuse new requests, finish every queued
+        one, stop the worker. Idempotent."""
+        self._open = False
+        self._worker.join(timeout)
+
+    close = drain

@@ -1,0 +1,257 @@
+"""InferenceEngine — a prototxt + snapshot held resident behind
+bucketed, AOT-compiled ``XLANet.apply`` executables.
+
+The one-shot tools (classify, extract_features) pay a full trace +
+XLA compile per invocation and per batch shape. A serving process
+cannot: request sizes vary per call and compilation is seconds while a
+request budget is milliseconds. The engine fixes a small set of batch
+*buckets* (default 1/8/32), AOT-compiles the forward once per bucket at
+warmup, and pads every request up to the nearest bucket — so steady
+state is pure execution, never compilation. Padding is sound because
+every layer in the zoo is per-row independent in TEST phase (convs,
+pools, FC, Softmax, BN-with-stored-stats, LRN): the padded rows cannot
+leak into the real rows, and the real rows' outputs are bit-identical
+to an unpadded run of the same executable bucket (tests/test_serve.py
+pins this).
+
+Compiled executables are cached per engine, keyed by (bucket, dtype);
+the net and weights are fixed per engine instance, so the key is
+effectively (net, bucket, dtype). Input buffers are donated to XLA on
+accelerators (they are request-scoped temporaries); donation is skipped
+on CPU where it only produces "donated buffer unused" noise.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+Rows = Union[np.ndarray, Dict[str, np.ndarray]]
+
+
+def load_weights_any(net, params, state, weights: str):
+    """Overlay weights from any trained artifact this repo produces:
+    ``.caffemodel`` / ``.npz`` weight files (comma-separated lists
+    overlay in order, later files winning — ``tools/_common`` rules) or
+    a full ``.solverstate.npz``/``.orbax`` training snapshot, from
+    which params + net state (BN statistics) are extracted."""
+    from ..solver import snapshot as snap
+
+    if weights.endswith((snap.NPZ_SUFFIX, snap.ORBAX_SUFFIX)):
+        from ..proto import caffemodel as cm
+
+        st = snap.load_state(weights)
+        p = cm.merge_into(jax.device_get(params), st["params"])
+        s = jax.device_get(state)
+        if st.get("state"):
+            s = cm.merge_into(s, st["state"])
+        to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        return to_dev(p), to_dev(s)
+    from ..tools._common import load_weights
+
+    return load_weights(net, params, state, weights)
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        net,
+        params,
+        state,
+        *,
+        buckets: Sequence[int] = (1, 8, 32),
+        output: Optional[str] = None,
+        compute_dtype: Any = jnp.float32,
+        metrics=None,
+    ):
+        """``net``: an ``XLANet`` (any phase; TEST semantics are forced
+        at apply time). ``output``: blob to return — defaults to the
+        final layer's first top. ``metrics``: optional ``ServeMetrics``
+        the engine reports per-bucket batch counts, padding waste and
+        device latency into."""
+        if not buckets:
+            raise ValueError("InferenceEngine: need at least one bucket")
+        self.net = net
+        self.params = params
+        self.state = state
+        self.buckets: Tuple[int, ...] = tuple(sorted({int(b) for b in buckets}))
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        self.compute_dtype = compute_dtype
+        self.metrics = metrics
+        self.output = output or net.layers[-1].top[0]
+        if self.output not in net.blob_shapes:
+            raise ValueError(
+                f"output blob {self.output!r} not in net "
+                f"(have: {sorted(net.blob_shapes)})"
+            )
+        producer = next(
+            (l for l in reversed(net.layers) if self.output in l.top), None
+        )
+        # topk() must not re-softmax a net that already ends in one
+        self.output_is_prob = producer is not None and producer.type == "Softmax"
+        self.input_names = list(net.input_names) or ["data"]
+        self._row_shapes = {
+            name: tuple(net.blob_shapes[name][1:]) for name in self.input_names
+        }
+        self._cache: Dict[Tuple[int, str], Any] = {}
+        self._compile_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_files(
+        cls, model: str, weights: Optional[str] = None, **kwargs
+    ) -> "InferenceEngine":
+        """Build from a deploy prototxt path plus optional weights
+        (``.caffemodel`` / ``.npz`` / ``.solverstate.npz``)."""
+        from ..nets.xlanet import XLANet
+        from ..proto import caffe_pb
+
+        net_param = caffe_pb.load_net(model)
+        net = XLANet(net_param, "TEST")
+        params, state = net.init(jax.random.PRNGKey(0))
+        if weights:
+            params, state = load_weights_any(net, params, state, weights)
+        return cls(net, params, state, **kwargs)
+
+    # ------------------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (the padding target); the largest
+        bucket when n exceeds it (the caller then chunks)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _input_dtype(self, name: str):
+        return jnp.int32 if name == "label" else self.compute_dtype
+
+    def _fwd(self, batch):
+        blobs, _ = self.net.apply(
+            self.params, self.state, batch, train=False, rng=None
+        )
+        return blobs[self.output]
+
+    def _executable(self, bucket: int):
+        key = (bucket, jnp.dtype(self.compute_dtype).name)
+        exe = self._cache.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._cache.get(key)
+            if exe is not None:
+                return exe
+            structs = {
+                name: jax.ShapeDtypeStruct(
+                    (bucket,) + self._row_shapes[name], self._input_dtype(name)
+                )
+                for name in self.input_names
+            }
+            donate = () if jax.default_backend() == "cpu" else (0,)
+            exe = (
+                jax.jit(self._fwd, donate_argnums=donate)
+                .lower(structs)
+                .compile()
+            )
+            self._cache[key] = exe
+        return exe
+
+    def warmup(self) -> "InferenceEngine":
+        """Compile every bucket up front, so the first request of each
+        size never pays a compile inside its latency budget."""
+        for b in self.buckets:
+            self._executable(b)
+        return self
+
+    # ------------------------------------------------------------------
+    def _as_batch(self, rows: Rows) -> Dict[str, np.ndarray]:
+        if not isinstance(rows, dict):
+            rows = {self.input_names[0]: rows}
+        batch = {}
+        n = None
+        for name, arr in rows.items():
+            if name not in self._row_shapes:
+                continue  # extra blobs the net doesn't take
+            arr = np.asarray(arr)
+            want = self._row_shapes[name]
+            if tuple(arr.shape[1:]) != want:
+                raise ValueError(
+                    f"input {name!r}: rows shaped {tuple(arr.shape[1:])}, "
+                    f"net wants {want}"
+                )
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(
+                    f"input {name!r}: {len(arr)} rows, others have {n}"
+                )
+            batch[name] = arr
+        if n is None or n == 0:
+            raise ValueError("infer: empty request")
+        # inputs the caller omitted (e.g. 'label' on a TEST-phase net
+        # whose requested output doesn't depend on it) ride as zeros
+        for name in self.input_names:
+            if name not in batch:
+                batch[name] = np.zeros(
+                    (n,) + self._row_shapes[name],
+                    jnp.dtype(self._input_dtype(name)).name,
+                )
+        return batch
+
+    def infer(self, rows: Rows) -> np.ndarray:
+        """Run the net on ``rows`` (an (N, ...) array for the first
+        input, or a dict blob name -> (N, ...) array). Requests are
+        padded up to the nearest bucket; N beyond the largest bucket is
+        chunked. Returns the output blob's first N rows as numpy."""
+        batch = self._as_batch(rows)
+        n = len(next(iter(batch.values())))
+        max_b = self.buckets[-1]
+        outs = []
+        start = 0
+        while start < n:
+            take = min(n - start, max_b)
+            bucket = self.bucket_for(take)
+            dev = {}
+            for name, arr in batch.items():
+                chunk = arr[start : start + take]
+                if take < bucket:
+                    pad = np.zeros(
+                        (bucket - take,) + chunk.shape[1:], chunk.dtype
+                    )
+                    chunk = np.concatenate([chunk, pad])
+                dev[name] = jnp.asarray(chunk, self._input_dtype(name))
+            exe = self._executable(bucket)
+            t0 = time.perf_counter()
+            out = np.asarray(exe(dev))  # np.asarray is the device fence
+            if self.metrics is not None:
+                self.metrics.record_batch(
+                    bucket,
+                    rows=take,
+                    padded_rows=bucket - take,
+                    device_s=time.perf_counter() - t0,
+                )
+            outs.append(out[:take])
+            start += take
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    # ------------------------------------------------------------------
+    def postprocess(self, out: np.ndarray, top_k: int = 5):
+        """Output-blob rows -> (indices (N, k), probs (N, k)); softmax
+        applied here iff the net did not already end in one."""
+        out = np.asarray(out, np.float64).reshape(len(out), -1)
+        if not self.output_is_prob:
+            out = np.exp(out - out.max(-1, keepdims=True))
+            out = out / out.sum(-1, keepdims=True)
+        idx = np.argsort(-out, axis=-1)[:, :top_k]
+        return idx, np.take_along_axis(out, idx, axis=-1)
+
+    def topk(self, rows: Rows, top_k: int = 5):
+        """infer + postprocess — the classification entry point the
+        classify tool and the HTTP server share."""
+        return self.postprocess(self.infer(rows), top_k)

@@ -1,0 +1,178 @@
+"""Serving observability: counters, latency histograms, gauges.
+
+Same discipline as ``bench.py`` records and ``utils/profiling``'s
+StepTimer: everything is windowed against wall-clock and dumpable as
+ONE JSON line, so a sweep log line or a ``/metrics`` scrape carries the
+whole serving picture — request/error counts, per-bucket batch counts
+and padding waste, p50/p95/p99 latencies, queue depth — without any
+external metrics stack.
+
+Histograms are fixed log-spaced bins (~1.47x steps, 10 µs .. ~5 min),
+so ``observe`` is O(log n_bins) with no allocation and percentiles are
+exact to bin resolution (<50% relative error worst-case, far less in
+the ms range serving lives in). All mutators are lock-protected; the
+batcher's worker, HTTP handler threads and load-generator threads all
+write concurrently.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+# ~1.47x geometric ladder: 10 µs -> ~300 s in 44 bins
+_BOUNDS_US: List[float] = []
+_b = 10.0
+while _b < 300e6:
+    _BOUNDS_US.append(round(_b, 1))
+    _b *= 1.468
+
+
+class LatencyHistogram:
+    """Log-binned latency histogram with percentile readout."""
+
+    def __init__(self):
+        self.counts = [0] * (len(_BOUNDS_US) + 1)
+        self.n = 0
+        self.total_us = 0.0
+
+    def observe(self, seconds: float) -> None:
+        us = max(seconds, 0.0) * 1e6
+        self.counts[bisect.bisect_left(_BOUNDS_US, us)] += 1
+        self.n += 1
+        self.total_us += us
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Upper bound (µs) of the bin holding the q-quantile, or None
+        when empty. q in [0, 1]."""
+        if not self.n:
+            return None
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c:
+                return (
+                    _BOUNDS_US[i] if i < len(_BOUNDS_US) else _BOUNDS_US[-1]
+                )
+        return _BOUNDS_US[-1]
+
+    def snapshot(self) -> dict:
+        def ms(v):
+            return None if v is None else round(v / 1000, 3)
+
+        return {
+            "count": self.n,
+            "mean_ms": ms(self.total_us / self.n) if self.n else None,
+            "p50_ms": ms(self.percentile(0.50)),
+            "p95_ms": ms(self.percentile(0.95)),
+            "p99_ms": ms(self.percentile(0.99)),
+        }
+
+
+class ServeMetrics:
+    """One registry per serving process. The engine reports device-side
+    per-bucket execution, the batcher reports end-to-end request
+    latency and queue depth, the server reports errors."""
+
+    def __init__(self, buckets=()):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._window_t0 = self._t0
+        self._window_requests = 0
+        self.requests = 0
+        self.rows = 0
+        self.errors = 0
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+        self.request_latency = LatencyHistogram()
+        self.per_bucket: Dict[int, dict] = {}
+        for b in buckets:
+            self._bucket(int(b))
+
+    def _bucket(self, bucket: int) -> dict:
+        entry = self.per_bucket.get(bucket)
+        if entry is None:
+            entry = self.per_bucket[bucket] = {
+                "batches": 0,
+                "rows": 0,
+                "padded_rows": 0,
+                "device": LatencyHistogram(),
+            }
+        return entry
+
+    # ------------------------------------------------------------- writes
+    def record_batch(
+        self, bucket: int, rows: int, padded_rows: int, device_s: float
+    ) -> None:
+        with self._lock:
+            e = self._bucket(bucket)
+            e["batches"] += 1
+            e["rows"] += rows
+            e["padded_rows"] += padded_rows
+            e["device"].observe(device_s)
+
+    def record_request(self, latency_s: float, rows: int = 1) -> None:
+        with self._lock:
+            self.requests += 1
+            self._window_requests += 1
+            self.rows += rows
+            self.request_latency.observe(latency_s)
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+            self.queue_depth_max = max(self.queue_depth_max, depth)
+
+    # -------------------------------------------------------------- reads
+    def snapshot(self) -> dict:
+        """JSON-able state. Also rolls the requests/s window (StepTimer
+        style): ``window_requests_per_sec`` covers the span since the
+        previous snapshot."""
+        with self._lock:
+            now = time.perf_counter()
+            uptime = max(now - self._t0, 1e-9)
+            window = max(now - self._window_t0, 1e-9)
+            out = {
+                "uptime_s": round(uptime, 3),
+                "requests": self.requests,
+                "rows": self.rows,
+                "errors": self.errors,
+                "requests_per_sec": round(self.requests / uptime, 2),
+                "window_requests_per_sec": round(
+                    self._window_requests / window, 2
+                ),
+                "queue_depth": self.queue_depth,
+                "queue_depth_max": self.queue_depth_max,
+                "request_latency": self.request_latency.snapshot(),
+                "per_bucket": {
+                    str(b): {
+                        "batches": e["batches"],
+                        "rows": e["rows"],
+                        "padded_rows": e["padded_rows"],
+                        # padding waste: fraction of device rows that
+                        # were padding (compiled-shape rows vs real)
+                        "padding_waste": round(
+                            e["padded_rows"]
+                            / max(e["rows"] + e["padded_rows"], 1),
+                            4,
+                        ),
+                        "device_latency": e["device"].snapshot(),
+                    }
+                    for b, e in sorted(self.per_bucket.items())
+                },
+            }
+            self._window_t0 = now
+            self._window_requests = 0
+            return out
+
+    def json_line(self) -> str:
+        """The one-line dump ``/metrics`` serves and sweep logs append."""
+        return json.dumps(self.snapshot())

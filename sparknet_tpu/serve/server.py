@@ -1,0 +1,215 @@
+"""Stdlib HTTP front end + in-process client for the serving engine.
+
+Endpoints (JSON in/out, no dependencies beyond the stdlib):
+
+- ``POST /classify``  body ``{"rows": [[...]...], "top_k": 5}`` —
+  rows are per-sample input arrays (net input shape, e.g. H×W×C
+  nested lists). Response ``{"indices": [[...]], "probs": [[...]]}``.
+  Shape errors -> 400; queue backpressure -> 503 with Retry-After.
+- ``GET /healthz`` — liveness + model identity + bucket config.
+- ``GET /metrics`` — the ServeMetrics snapshot, one JSON object.
+
+The server is a ``ThreadingHTTPServer``: handler threads block on the
+batcher future while the single batcher worker feeds the device, so
+concurrent requests coalesce into full buckets. ``Client`` wraps
+``http.client`` for tests and the load generator — same wire path as
+external traffic, no test-only shortcuts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .batcher import Backpressure, MicroBatcher
+from .metrics import ServeMetrics
+
+
+class InferenceServer:
+    def __init__(
+        self,
+        engine,
+        *,
+        batcher: Optional[MicroBatcher] = None,
+        metrics: Optional[ServeMetrics] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        model_name: str = "net",
+        default_top_k: int = 5,
+        request_timeout_s: float = 60.0,
+    ):
+        """``port=0`` binds an ephemeral port (tests); the bound port is
+        ``self.port`` either way."""
+        self.engine = engine
+        self.metrics = (
+            metrics
+            if metrics is not None
+            else ServeMetrics(getattr(engine, "buckets", ()))
+        )
+        if getattr(engine, "metrics", None) is None:
+            engine.metrics = self.metrics
+        self.batcher = batcher or MicroBatcher(engine, metrics=self.metrics)
+        self.model_name = model_name
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # one serving process, many scrapes: keep the access log off
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, payload: dict, headers=()):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(
+                        200,
+                        {
+                            "status": "ok",
+                            "model": outer.model_name,
+                            "buckets": list(
+                                getattr(outer.engine, "buckets", ())
+                            ),
+                            "output": getattr(outer.engine, "output", None),
+                        },
+                    )
+                elif self.path == "/metrics":
+                    self._reply(200, outer.metrics.snapshot())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/classify":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    rows = np.asarray(req["rows"], np.float32)
+                    top_k = int(req.get("top_k", outer.default_top_k))
+                except (KeyError, ValueError, TypeError) as e:
+                    outer.metrics.record_error()
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    fut = outer.batcher.submit(rows)
+                except Backpressure as e:
+                    outer.metrics.record_error()
+                    self._reply(
+                        503, {"error": str(e)}, headers=(("Retry-After", "1"),)
+                    )
+                    return
+                except ValueError as e:
+                    outer.metrics.record_error()
+                    self._reply(400, {"error": str(e)})
+                    return
+                try:
+                    out = fut.result(timeout=outer.request_timeout_s)
+                except FuturesTimeout:
+                    outer.metrics.record_error()
+                    fut.cancel()
+                    self._reply(504, {"error": "inference timed out"})
+                    return
+                except Exception as e:
+                    # engine-side failure (bad shape surfaces here too:
+                    # validation lives in ONE place, the engine). The
+                    # batcher already counted it — don't double-count.
+                    code = 400 if isinstance(e, ValueError) else 500
+                    self._reply(
+                        code, {"error": f"{type(e).__name__}: {e}"}
+                    )
+                    return
+                idx, probs = outer.engine.postprocess(out, top_k)
+                self._reply(
+                    200,
+                    {"indices": idx.tolist(), "probs": probs.tolist()},
+                )
+
+        self.default_top_k = default_top_k
+        self.request_timeout_s = request_timeout_s
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="serve-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop accepting, drain the batcher, close the socket."""
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(10)
+        self.batcher.drain()
+        self._httpd.server_close()
+
+    def serve_forever(self) -> None:
+        """Foreground mode for the CLI: blocks until interrupted."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.batcher.drain()
+            self._httpd.server_close()
+
+    def client(self, timeout: float = 60.0) -> "Client":
+        return Client(self.host, self.port, timeout=timeout)
+
+
+class Client:
+    """Programmatic client over the same HTTP surface (tests, loadgen)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, payload=None):
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+        try:
+            body = None if payload is None else json.dumps(payload)
+            headers = (
+                {} if body is None else {"Content-Type": "application/json"}
+            )
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+            return resp.status, data
+        finally:
+            conn.close()
+
+    def healthz(self):
+        return self._request("GET", "/healthz")
+
+    def metrics(self):
+        return self._request("GET", "/metrics")
+
+    def classify(self, rows, top_k: int = 5):
+        rows = np.asarray(rows)
+        return self._request(
+            "POST", "/classify", {"rows": rows.tolist(), "top_k": top_k}
+        )

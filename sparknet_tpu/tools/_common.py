@@ -20,15 +20,43 @@ def honor_platform_env():
     PJRT client instead of falling back. When the user explicitly asked
     for a non-axon platform via the env var, re-assert it through
     ``jax.config``, which the hook respects. Call at CLI-main entry,
-    before anything touches a device."""
+    before anything touches a device.
+
+    Robustness (ADVICE r05 #4): the env/config comparison is
+    normalized (strip + casefold per platform entry) so ``"cpu "`` or
+    ``"CPU"`` still matches, and the config update is wrapped so a
+    backend some earlier import already initialized turns this into a
+    warning no-op instead of a CLI crash."""
     import os
 
+    def _norm(s: str):
+        return tuple(
+            p.strip().lower() for p in str(s).split(",") if p.strip()
+        )
+
     want = os.environ.get("JAX_PLATFORMS", "")
-    if want and "axon" not in want.split(","):
+    want_norm = _norm(want)
+    if want_norm and "axon" not in want_norm:
         import jax
 
-        if str(getattr(jax.config, "jax_platforms", "") or "") != want:
-            jax.config.update("jax_platforms", want)
+        # compare the RAW config against the canonical spelling: jax's
+        # backend registry only knows lowercase names, so a raw 'CPU '
+        # in the config (jax mirrors the env var verbatim) must be
+        # rewritten even though it normalizes to the same platforms
+        want_str = ",".join(want_norm)
+        if str(getattr(jax.config, "jax_platforms", "") or "") != want_str:
+            try:
+                jax.config.update("jax_platforms", want_str)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"honor_platform_env: could not re-assert "
+                    f"JAX_PLATFORMS={want!r} (backend already "
+                    f"initialized?): {type(e).__name__}: {e}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
 
 
 def find_data_layer(net_param, phase: str):

@@ -4,6 +4,10 @@ The reference era's ``classification.cpp`` / ``classify.py`` workflow:
 load a deploy NetParameter, overlay trained weights, preprocess images
 (resize, BGR, mean subtract) and report top-k classes.
 
+Inference routes through ``serve.InferenceEngine`` — the ONE compile
+path shared with the serving subsystem and extract_features, so the
+one-shot tool and the persistent server cannot drift.
+
     python -m sparknet_tpu.tools.classify \
         --model deploy.prototxt --weights model.caffemodel \
         [--mean mean.binaryproto] [--labels synset_words.txt] img.jpg...
@@ -17,7 +21,6 @@ from typing import List, Optional
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 
 def load_model(model: str, weights: Optional[str] = None, batch: int = 1):
@@ -28,16 +31,9 @@ def load_model(model: str, weights: Optional[str] = None, batch: int = 1):
     net = XLANet(net_param, "TEST")
     params, state = net.init(jax.random.PRNGKey(0))
     if weights:
-        from ..proto import caffemodel as cm
+        from ..serve.engine import load_weights_any
 
-        imported, st = cm.import_caffemodel(weights, net)
-        params = jax.tree_util.tree_map(
-            jnp.asarray, cm.merge_into(jax.device_get(params), imported)
-        )
-        if st:
-            state = jax.tree_util.tree_map(
-                jnp.asarray, cm.merge_into(jax.device_get(state), st)
-            )
+        params, state = load_weights_any(net, params, state, weights)
     return net, params, state
 
 
@@ -56,20 +52,24 @@ def preprocess(
     return np.stack(out)
 
 
-def classify(net, params, state, batch_hwc: np.ndarray, top_k: int = 5):
+def make_engine(net, params, state, buckets=(1, 8, 32)):
+    """The resident engine main() classifies through — shared compile
+    path with ``tools/serve`` and ``extract_features``."""
+    from ..serve.engine import InferenceEngine
+
+    return InferenceEngine(net, params, state, buckets=buckets)
+
+
+def classify(
+    net, params, state, batch_hwc: np.ndarray, top_k: int = 5, engine=None
+):
     """-> (indices (N, top_k), probs (N, top_k)) from the net's final
-    blob (softmaxed here if the deploy net ends in logits)."""
-    name = net.input_names[0] if net.input_names else "data"
-    blobs, _ = net.apply(
-        params, state, {name: jnp.asarray(batch_hwc)}, train=False, rng=None
-    )
-    last = net.layers[-1]
-    out = np.asarray(blobs[last.top[0]], np.float64)
-    if last.type not in ("Softmax",):
-        out = np.exp(out - out.max(-1, keepdims=True))
-        out = out / out.sum(-1, keepdims=True)
-    idx = np.argsort(-out, axis=-1)[:, :top_k]
-    return idx, np.take_along_axis(out, idx, axis=-1)
+    blob (softmaxed by the engine if the deploy net ends in logits).
+    One-shot callers get a single-bucket engine sized to the batch (no
+    padding); pass ``engine`` to reuse compiled executables."""
+    if engine is None:
+        engine = make_engine(net, params, state, buckets=(len(batch_hwc),))
+    return engine.topk(batch_hwc, top_k)
 
 
 def main(argv=None):
@@ -98,7 +98,8 @@ def main(argv=None):
         labels = [l.strip() for l in open(args.labels)]
 
     batch = preprocess(args.images, size, mean)
-    idx, probs = classify(net, params, state, batch, args.top_k)
+    engine = make_engine(net, params, state, buckets=(len(batch),))
+    idx, probs = classify(net, params, state, batch, args.top_k, engine=engine)
     for img, row_i, row_p in zip(args.images, idx, probs):
         print(f"{img}:")
         for i, p in zip(row_i, row_p):

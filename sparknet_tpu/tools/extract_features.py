@@ -19,7 +19,6 @@ from typing import Optional
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 
 def extract(
@@ -51,10 +50,11 @@ def extract(
     if weights:
         params, state = load_weights(net, params, state, weights)
 
-    @jax.jit
-    def fwd(batch):
-        blobs, _ = net.apply(params, state, batch, train=False, rng=None)
-        return blobs[blob]
+    # the serving engine is the one compile path for all inference
+    # tools: one bucket, exactly the data layer's batch size
+    from ..serve.engine import InferenceEngine
+
+    engine = InferenceEngine(net, params, state, output=blob, buckets=(bs,))
 
     feed = ds.batches(
         bs, shuffle=False, seed=0, transform=batch_transform_fn(tf)
@@ -62,9 +62,7 @@ def extract(
     items = []
     for it in range(iterations):
         batch = next(feed)
-        feats = np.asarray(
-            fwd({k: jnp.asarray(v) for k, v in batch.items()}), np.float32
-        )
+        feats = np.asarray(engine.infer(batch), np.float32)
         flat = feats.reshape(len(feats), -1)
         for j, f in enumerate(flat):
             # Caffe stores features as channels=D, h=1, w=1 Datums;

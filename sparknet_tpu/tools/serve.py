@@ -1,0 +1,145 @@
+"""serve — persistent batched inference over a deploy prototxt.
+
+The caffe-era spelling of a model server: point it at a zoo deploy
+net plus trained weights and it holds the compiled executables
+resident, micro-batching a request stream through them.
+
+    python -m sparknet_tpu.tools.serve \
+        --model deploy.prototxt --weights model.npz --port 8080 \
+        [--buckets 1,8,32] [--max-latency-us 2000] [--max-queue 256]
+
+Weights may be a ``.caffemodel``, a ``.npz`` WeightCollection, or a
+full ``.solverstate.npz`` training snapshot (params + BN stats are
+extracted). ``--bench N`` skips the HTTP server and instead runs the
+offline closed-loop load generator for N requests, printing one
+bench.py-style JSON record — the serving twin of training img/s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def _int_list(text: str):
+    vals = [int(v) for v in text.split(",") if v.strip()]
+    if not vals:
+        raise argparse.ArgumentTypeError(f"empty int list: {text!r}")
+    return vals
+
+
+def main(argv=None):
+    from ._common import honor_platform_env
+
+    honor_platform_env()
+    ap = argparse.ArgumentParser(
+        prog="serve", description="batched deploy-net inference server"
+    )
+    ap.add_argument("--model", required=True, help="deploy .prototxt")
+    ap.add_argument(
+        "--weights",
+        default=None,
+        help=".caffemodel | .npz | .solverstate.npz",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument(
+        "--buckets",
+        type=_int_list,
+        default=[1, 8, 32],
+        help="batch-size buckets to pre-compile (requests pad up)",
+    )
+    ap.add_argument(
+        "--max-batch",
+        type=int,
+        default=0,
+        help="rows per engine call (default: largest bucket)",
+    )
+    ap.add_argument(
+        "--max-latency-us",
+        type=int,
+        default=2000,
+        help="longest a request waits for batch co-riders",
+    )
+    ap.add_argument(
+        "--max-queue",
+        type=int,
+        default=256,
+        help="queued-request bound (backpressure -> HTTP 503)",
+    )
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument(
+        "--bench",
+        type=int,
+        default=0,
+        metavar="N",
+        help="offline mode: run the closed-loop load generator for N "
+        "requests and print one JSON record instead of serving",
+    )
+    ap.add_argument("--bench-concurrency", type=int, default=4)
+    ap.add_argument(
+        "--bench-sizes",
+        type=_int_list,
+        default=[1, 2, 5, 8, 3],
+        help="request row-counts the load generator cycles through",
+    )
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from ..serve.batcher import MicroBatcher
+    from ..serve.engine import InferenceEngine
+    from ..serve.loadgen import run_loadgen
+    from ..serve.metrics import ServeMetrics
+    from ..serve.server import InferenceServer
+
+    metrics = ServeMetrics(args.buckets)
+    engine = InferenceEngine.from_files(
+        args.model,
+        args.weights,
+        buckets=args.buckets,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        metrics=metrics,
+    )
+    engine.warmup()
+    batcher = MicroBatcher(
+        engine,
+        max_batch=args.max_batch,
+        max_latency_us=args.max_latency_us,
+        max_queue=args.max_queue,
+        metrics=metrics,
+    )
+
+    if args.bench:
+        record = run_loadgen(
+            engine,
+            n_requests=args.bench,
+            sizes=args.bench_sizes,
+            concurrency=args.bench_concurrency,
+            batcher=batcher,
+            metrics=metrics,
+        )
+        batcher.drain()
+        print(json.dumps(record))
+        return record
+
+    server = InferenceServer(
+        engine,
+        batcher=batcher,
+        metrics=metrics,
+        host=args.host,
+        port=args.port,
+        model_name=args.model,
+        default_top_k=args.top_k,
+    )
+    print(
+        f"serving {args.model} on http://{server.host}:{server.port} "
+        f"(buckets={engine.buckets}, max_latency_us={args.max_latency_us})"
+    )
+    server.serve_forever()
+    return server
+
+
+if __name__ == "__main__":
+    main()

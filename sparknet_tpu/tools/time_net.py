@@ -102,14 +102,25 @@ def time_per_layer(net, params, state, batch, iters: int = 10,
     still yields real per-iteration numbers. A tiny data-dependent
     carry (sum(outputs) * 1e-38 added to the float inputs) threads the
     iterations so XLA can neither hoist the layer out of the loop nor
-    dead-code-eliminate its outputs; the added cost is one read-pass
-    over each output per iteration, negligible for compute-bound layers
-    and a bounded (~one-pass) bias for bandwidth-bound ones."""
+    dead-code-eliminate its outputs.
+
+    That harness is not free: each iteration pays a carry-add pass over
+    every float input, plus the float32 reduction over the outputs
+    (fwd) / over every gradient leaf INCLUDING the large param grads
+    (bwd) — a real bias for bandwidth-bound layers. So each scanned
+    row also measures a carry-only BASELINE scan (the same carry-add +
+    reduction passes over same-shaped arrays, with the layer itself
+    removed) and subtracts it, clamped at zero (ADVICE r05 #2). The
+    baseline approximates the harness overhead to within a memory pass
+    (it reduces where the real body writes), so corrected ms are
+    estimates good to roughly one pass over the layer's operands; a
+    0.000 entry means the layer timed at or below the harness floor."""
     from ..nets.layers import DATA_LAYER_TYPES, LAYER_IMPLS, ApplyCtx
     from jax import lax
 
     blobs = dict(batch)
     rows = []
+    baseline_cache: dict = {}
     for li, lp in enumerate(net.layers):
         if lp.type in DATA_LAYER_TYPES:
             continue
@@ -148,6 +159,34 @@ def time_per_layer(net, params, state, batch, iters: int = 10,
             jax.block_until_ready(jf(jnp.float32(0.0)))
             return 1000 * (time.perf_counter() - t0) / n
 
+        def _harness_ms(arrays, n):
+            """ms/iter of the scan harness alone: the carry-add + f32
+            reduction pass over ``arrays`` (same shapes/dtypes the real
+            body touches) with the layer removed — subtracted from the
+            scanned measurement. The carry-add keeps every pass
+            data-dependent so XLA cannot hoist it. Cached by shape
+            signature: repeated layer geometries (ReLU/pool stacks)
+            share one baseline compile."""
+            key = (
+                n,
+                tuple(
+                    sorted(
+                        (tuple(a.shape), str(a.dtype)) for a in arrays
+                    )
+                ),
+            )
+            if key not in baseline_cache:
+                def base_once(carry, arrays=tuple(arrays)):
+                    s = jnp.float32(0.0)
+                    for a in arrays:
+                        s = s + jnp.sum(
+                            (a + carry.astype(a.dtype)).astype(jnp.float32)
+                        )
+                    return s * jnp.float32(1e-38)
+
+                baseline_cache[key] = _scan_time(base_once, n)
+            return baseline_cache[key]
+
         # compile ONCE (AOT) and use the executable for both the timing
         # loop and cost analysis
         jfwd = jax.jit(fwd).lower(p, inputs).compile()
@@ -162,7 +201,14 @@ def time_per_layer(net, params, state, batch, iters: int = 10,
                 outs_ = fwd(p, inputs_)
                 s = sum(jnp.sum(o.astype(jnp.float32)) for o in outs_)
                 return s * jnp.float32(1e-38)
-            fwd_ms = _scan_time(fwd_once, scan_iters)
+            fwd_raw = _scan_time(fwd_once, scan_iters)
+            fwd_ms = max(
+                fwd_raw
+                - _harness_ms(
+                    [inputs[i] for i in fidx_all] + list(outs), scan_iters
+                ),
+                0.0,
+            )
         else:
             t0 = time.perf_counter()
             for _ in range(iters):
@@ -209,7 +255,19 @@ def time_per_layer(net, params, state, batch, iters: int = 10,
                             for leaf in jax.tree_util.tree_leaves(g_)
                         )
                         return s * jnp.float32(1e-38)
-                    bwd_ms = _scan_time(bwd_once, scan_iters)
+                    bwd_raw = _scan_time(bwd_once, scan_iters)
+                    # bwd grad leaves are param-shaped + input-shaped:
+                    # baseline over params + inputs matches the
+                    # reduction the real body pays over them
+                    bwd_ms = max(
+                        bwd_raw
+                        - _harness_ms(
+                            [inputs[i] for i in fidx]
+                            + list(jax.tree_util.tree_leaves(p)),
+                            scan_iters,
+                        ),
+                        0.0,
+                    )
                 else:
                     jbwd = jax.jit(grad_fn)
                     finputs = [inputs[i] for i in fidx]

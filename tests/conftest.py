@@ -40,15 +40,18 @@ if os.environ.get("SPARKNET_TEST_NO_CACHE", "") in ("", "0"):
     # subprocess-spawning tests — app CLIs, multi-host clusters, bench
     # invocations — share the same cache; jax reads these at init)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    # min compile time 1s, NOT 0: persisting the near-instant compiles
+    # deterministically segfaults this jaxlib's cache serialization
+    # (reproduced on test_snapshot's resume tests — the crash that was
+    # truncating every tier-1 run at ~60% since the seed; 2026-08-04).
+    # Sub-second compiles are cheaper to redo than the crash costs.
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
     # config mirrors the POST-setdefault env values, so a user-provided
     # JAX_COMPILATION_CACHE_DIR keeps parent and subprocess tests in the
     # SAME cache (the whole point) instead of splitting them
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
-    # cache every entry, however small/fast — the suite's cost is many
-    # medium compiles, not a few giant ones
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs",
         int(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),

@@ -20,6 +20,26 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
+def test_console_entry_points_resolve():
+    """Every [project.scripts] target in pyproject.toml must import and
+    expose its callable — ``serve`` and friends ship as console
+    commands, and a typo'd target only fails at install time otherwise."""
+    import importlib
+    import re
+
+    with open(os.path.join(_ROOT, "pyproject.toml")) as fh:
+        text = fh.read()
+    section = re.search(
+        r"\[project\.scripts\]\n(.*?)(\n\[|\Z)", text, re.S
+    ).group(1)
+    targets = dict(re.findall(r'([\w-]+)\s*=\s*"([\w.:]+)"', section))
+    assert "serve" in targets and "cifar-app" in targets
+    for name, target in targets.items():
+        mod_name, _, attr = target.partition(":")
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, attr)), f"{name} -> {target}"
+
+
 def test_entry_compiles_and_runs():
     import __graft_entry__ as ge
 

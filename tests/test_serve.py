@@ -1,0 +1,388 @@
+"""serve/ subsystem: bucket-padding correctness, micro-batching
+semantics, HTTP surface, load generator, and parity with the one-shot
+classify tool (which now routes through the same engine)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.serve.batcher import Backpressure, MicroBatcher
+from sparknet_tpu.serve.engine import InferenceEngine
+from sparknet_tpu.serve.loadgen import run_loadgen
+from sparknet_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from sparknet_tpu.serve.server import InferenceServer
+
+ZOO = os.path.join(
+    os.path.dirname(__file__), "..", "sparknet_tpu", "models", "prototxt"
+)
+CIFAR_DEPLOY = os.path.join(ZOO, "cifar10_quick_deploy.prototxt")
+
+# a tiny deploy net: fast compiles, still exercises conv/pool/fc/softmax
+TOY_DEPLOY = """
+name: "toy"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+        convolution_param { num_output: 4 kernel_size: 3 pad: 1
+          weight_filler { type: "gaussian" std: 0.2 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+        pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+        inner_product_param { num_output: 5
+          weight_filler { type: "gaussian" std: 0.2 } } }
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+def toy_engine(buckets=(4, 8), metrics=None, warm=True):
+    from sparknet_tpu.nets.xlanet import XLANet
+    from sparknet_tpu.proto import caffe_pb
+
+    net = XLANet(caffe_pb.load_net(TOY_DEPLOY, is_path=False), "TEST")
+    params, state = net.init(jax.random.PRNGKey(7))
+    eng = InferenceEngine(
+        net, params, state, buckets=buckets, metrics=metrics
+    )
+    return eng.warmup() if warm else eng
+
+
+def toy_rows(n, seed=0, shape=(8, 8, 3)):
+    return (
+        np.random.default_rng(seed).normal(size=(n,) + shape)
+        .astype(np.float32)
+    )
+
+
+# ---------------------------------------------------------------- engine
+def test_bucket_padding_bit_identical():
+    """Padded-bucket outputs must be BIT-identical per-row to a direct
+    unpadded XLANet.apply on the same rows (the acceptance bar)."""
+    eng = toy_engine(buckets=(4,))
+    rows = toy_rows(3)
+    out = eng.infer(rows)  # 3 rows padded up to the 4-bucket
+    direct_blobs, _ = eng.net.apply(
+        eng.params, eng.state, {"data": jnp.asarray(rows)},
+        train=False, rng=None,
+    )
+    np.testing.assert_array_equal(out, np.asarray(direct_blobs["prob"]))
+
+
+def test_engine_buckets_and_chunking():
+    m = ServeMetrics((2, 4))
+    eng = toy_engine(buckets=(2, 4), metrics=m)
+    assert eng.bucket_for(1) == 2 and eng.bucket_for(3) == 4
+    assert eng.bucket_for(99) == 4  # beyond the ladder -> chunked
+    rows = toy_rows(11)
+    out = eng.infer(rows)
+    assert out.shape == (11, 5)
+    # chunked run must equal one unchunked reference row-for-row
+    ref = eng.net.apply(
+        eng.params, eng.state, {"data": jnp.asarray(rows)},
+        train=False, rng=None,
+    )[0]["prob"]
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-6, atol=1e-7)
+    snap = m.snapshot()
+    # 11 rows = 4 + 4 + 3(padded to 4): all batches in the 4-bucket
+    assert snap["per_bucket"]["4"]["batches"] == 3
+    assert snap["per_bucket"]["4"]["padded_rows"] == 1
+    assert snap["per_bucket"]["4"]["padding_waste"] > 0
+
+
+def test_engine_rejects_bad_shapes_and_empty():
+    eng = toy_engine(buckets=(2,))
+    with pytest.raises(ValueError, match="net wants"):
+        eng.infer(toy_rows(2, shape=(4, 4, 3)))
+    with pytest.raises(ValueError, match="empty"):
+        eng.infer(np.zeros((0, 8, 8, 3), np.float32))
+
+
+def test_engine_executable_cache_is_per_bucket():
+    eng = toy_engine(buckets=(2, 4), warm=False)
+    assert not eng._cache
+    eng.infer(toy_rows(1))
+    assert len(eng._cache) == 1  # only the 2-bucket compiled
+    eng.infer(toy_rows(1))
+    assert len(eng._cache) == 1  # cache hit, no recompile
+    eng.warmup()
+    assert len(eng._cache) == 2
+
+
+def test_engine_topk_postprocess():
+    eng = toy_engine(buckets=(4,))
+    idx, probs = eng.topk(toy_rows(3), top_k=3)
+    assert idx.shape == (3, 3) and probs.shape == (3, 3)
+    assert np.all(probs >= 0) and np.all(probs[:, 0] >= probs[:, 1])
+    # output blob is a Softmax: postprocess must not re-softmax
+    out = eng.infer(toy_rows(3))
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+    top = np.sort(np.asarray(out, np.float64), -1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(np.sort(probs, -1)[:, ::-1], top, rtol=1e-6)
+
+
+# --------------------------------------------------------------- batcher
+def test_batcher_max_latency_flush():
+    """A lone request must come back after ~max_latency even when the
+    batch never fills."""
+    m = ServeMetrics()
+    eng = toy_engine(buckets=(2, 4), metrics=m)
+    b = MicroBatcher(eng, max_batch=4, max_latency_us=30_000, metrics=m)
+    t0 = time.perf_counter()
+    out = b.submit(toy_rows(1)).result(timeout=10)
+    dt = time.perf_counter() - t0
+    assert out.shape == (1, 5)
+    assert dt < 5.0  # flushed by the latency knob, not stuck
+    snap = m.snapshot()
+    assert snap["requests"] == 1 and snap["errors"] == 0
+    b.drain()
+
+
+def test_batcher_coalesces_to_max_batch():
+    """max_batch concurrent 1-row requests must ride ONE engine batch
+    (and flush immediately on filling, not wait out the window)."""
+    m = ServeMetrics()
+    eng = toy_engine(buckets=(4,), metrics=m)
+    b = MicroBatcher(eng, max_batch=4, max_latency_us=2_000_000, metrics=m)
+    t0 = time.perf_counter()
+    futs = [b.submit(toy_rows(1, seed=i)) for i in range(4)]
+    outs = [f.result(timeout=10) for f in futs]
+    dt = time.perf_counter() - t0
+    assert all(o.shape == (1, 5) for o in outs)
+    assert dt < 1.5  # full batch flushed without waiting the 2s window
+    snap = m.snapshot()
+    assert snap["requests"] == 4
+    assert snap["per_bucket"]["4"]["batches"] == 1  # coalesced
+    # each rider's rows come back in submit order
+    for i, o in enumerate(outs):
+        ref = eng.infer(toy_rows(1, seed=i))
+        np.testing.assert_array_equal(o, ref)
+    b.drain()
+
+
+class _StubEngine:
+    """Duck-typed engine whose infer blocks until released — makes
+    backpressure deterministic without timing races."""
+
+    buckets = (8,)
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.started = threading.Event()
+
+    def infer(self, rows):
+        self.started.set()
+        assert self.release.wait(10)
+        return np.asarray(rows)
+
+
+def test_batcher_backpressure_bounded_queue():
+    stub = _StubEngine()
+    b = MicroBatcher(stub, max_batch=1, max_latency_us=0, max_queue=2)
+    first = b.submit(np.zeros((1, 3), np.float32))
+    assert stub.started.wait(10)  # worker is busy inside infer
+    q1 = b.submit(np.zeros((1, 3), np.float32))
+    q2 = b.submit(np.zeros((1, 3), np.float32))
+    with pytest.raises(Backpressure):
+        b.submit(np.zeros((1, 3), np.float32))
+    stub.release.set()
+    for f in (first, q1, q2):
+        assert f.result(timeout=10).shape == (1, 3)
+    b.drain()
+    with pytest.raises(RuntimeError, match="drained"):
+        b.submit(np.zeros((1, 3), np.float32))
+
+
+def test_batcher_engine_error_propagates_to_future():
+    m = ServeMetrics()
+    eng = toy_engine(buckets=(2,), metrics=m)
+    b = MicroBatcher(eng, metrics=m)
+    fut = b.submit(toy_rows(1, shape=(2, 2, 3)))  # wrong input shape
+    with pytest.raises(ValueError, match="net wants"):
+        fut.result(timeout=10)
+    assert m.snapshot()["errors"] == 1
+    b.drain()
+
+
+# --------------------------------------------------------------- metrics
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for ms in range(1, 101):  # 1..100 ms uniform
+        h.observe(ms / 1000)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    # log-binned: percentile is exact to bin resolution (<47% up-error)
+    assert 45 <= snap["p50_ms"] <= 75
+    assert 90 <= snap["p99_ms"] <= 150
+    assert snap["p50_ms"] <= snap["p95_ms"] <= snap["p99_ms"]
+    assert LatencyHistogram().snapshot()["p50_ms"] is None
+
+
+def test_metrics_json_line_roundtrip():
+    import json
+
+    m = ServeMetrics((1, 8))
+    m.record_batch(8, rows=5, padded_rows=3, device_s=0.004)
+    m.record_request(0.01, rows=5)
+    rec = json.loads(m.json_line())
+    assert rec["requests"] == 1 and rec["rows"] == 5
+    assert rec["per_bucket"]["8"]["padding_waste"] == 0.375
+    assert rec["per_bucket"]["8"]["device_latency"]["count"] == 1
+
+
+# ---------------------------------------------------------------- server
+def test_server_healthz_metrics_classify_roundtrip():
+    m = ServeMetrics((4,))
+    eng = toy_engine(buckets=(4,), metrics=m)
+    srv = InferenceServer(
+        eng, metrics=m, port=0, model_name="toy",
+        batcher=MicroBatcher(eng, max_latency_us=5_000, metrics=m),
+    ).start()
+    try:
+        c = srv.client()
+        st, health = c.healthz()
+        assert st == 200 and health["status"] == "ok"
+        assert health["model"] == "toy" and health["buckets"] == [4]
+
+        st, resp = c.classify(toy_rows(2), top_k=3)
+        assert st == 200
+        assert np.asarray(resp["indices"]).shape == (2, 3)
+        probs = np.asarray(resp["probs"])
+        assert np.all(probs[:, 0] >= probs[:, 1])
+
+        st, resp = c.classify(toy_rows(1, shape=(2, 2, 3)))
+        assert st == 400 and "net wants" in resp["error"]
+
+        st, missing = c._request("GET", "/nope")
+        assert st == 404
+
+        st, met = c.metrics()
+        assert st == 200
+        assert met["requests"] == 1  # the good classify
+        assert met["errors"] == 1  # the bad-shape classify
+        assert met["per_bucket"]["4"]["batches"] == 1
+        assert met["request_latency"]["count"] == 1
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- classify-tool parity
+def test_engine_matches_classify_tool_on_zoo_net():
+    """classify (one-shot tool) and a bucketed serving engine over the
+    zoo cifar10_quick deploy net must produce identical top-k."""
+    from sparknet_tpu.tools import classify as classify_mod
+
+    net, params, state = classify_mod.load_model(CIFAR_DEPLOY)
+    # random weights: zero-init would softmax to uniform rows where
+    # top-k ordering is meaningless
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(3), len(leaves))
+    params = jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            jax.random.normal(k, l.shape, l.dtype) * 0.05
+            for k, l in zip(keys, leaves)
+        ],
+    )
+    rows = toy_rows(5, seed=2, shape=(32, 32, 3))
+    idx_tool, probs_tool = classify_mod.classify(
+        net, params, state, rows, top_k=4
+    )
+    eng = InferenceEngine(net, params, state, buckets=(8,))
+    idx_srv, probs_srv = eng.topk(rows, top_k=4)
+    np.testing.assert_array_equal(idx_tool, idx_srv)
+    np.testing.assert_allclose(probs_tool, probs_srv, rtol=1e-6)
+
+
+# --------------------------------------------------------------- loadgen
+def test_loadgen_closed_loop_record():
+    m = ServeMetrics((2, 4))
+    eng = toy_engine(buckets=(2, 4), metrics=m)
+    rec = run_loadgen(
+        eng, n_requests=40, sizes=(1, 2, 5), concurrency=3, metrics=m
+    )
+    assert rec["metric"] == "serve_requests_per_sec"
+    assert rec["value"] > 0 and rec["errors"] == 0
+    assert rec["requests"] == 40
+    assert rec["rows"] == sum((1, 2, 5)[i % 3] for i in range(40))
+    assert rec["metrics"]["requests"] == 40
+    assert rec["p99_ms"] is not None and rec["p99_ms"] >= rec["p50_ms"]
+    # mixed sizes must exercise more than one bucket
+    used = {
+        b
+        for b, e in rec["metrics"]["per_bucket"].items()
+        if e["batches"] > 0
+    }
+    assert len(used) >= 2, rec["metrics"]["per_bucket"]
+
+
+# -------------------------------------------------------------- CLI e2e
+def test_serve_cli_bench_toy(tmp_path, capsys):
+    """The acceptance flow in miniature: serve CLI loads a deploy net +
+    .npz weights, the closed-loop generator pushes mixed-size requests,
+    and the final record shows zero errors + per-bucket histograms."""
+    import json
+
+    from sparknet_tpu.nets.weights import save_npz
+    from sparknet_tpu.tools import serve as serve_cli
+
+    deploy = tmp_path / "toy_deploy.prototxt"
+    deploy.write_text(TOY_DEPLOY)
+    eng0 = toy_engine(buckets=(1,), warm=False)
+    npz = str(tmp_path / "toy.npz")
+    save_npz(npz, jax.device_get(eng0.params))
+
+    rec = serve_cli.main(
+        [
+            "--model", str(deploy), "--weights", npz,
+            "--buckets", "1,4", "--max-latency-us", "1000",
+            "--bench", "60", "--bench-sizes", "1,3,6",
+            "--bench-concurrency", "3",
+        ]
+    )
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["requests"] == 60  # the printed JSON record
+    assert rec["errors"] == 0 and rec["requests"] == 60
+    assert rec["metrics"]["errors"] == 0
+    hist = rec["metrics"]["per_bucket"]
+    assert sum(e["batches"] for e in hist.values()) > 0
+    assert all(
+        e["device_latency"]["count"] == e["batches"] for e in hist.values()
+    )
+
+
+@pytest.mark.slow
+def test_serve_cli_bench_cifar10_quick(capsys, tmp_path):
+    """Full acceptance run: cifar10_quick deploy + npz snapshot, >= 500
+    mixed-size requests, zero errors, correct counts, per-bucket
+    latency histograms (the ISSUE 1 acceptance criteria verbatim)."""
+    import json
+
+    from sparknet_tpu.nets.weights import save_npz
+    from sparknet_tpu.tools import classify as classify_mod
+    from sparknet_tpu.tools import serve as serve_cli
+
+    net, params, state = classify_mod.load_model(CIFAR_DEPLOY)
+    npz = str(tmp_path / "cifar10_quick.npz")
+    save_npz(npz, jax.device_get(params))
+
+    rec = serve_cli.main(
+        [
+            "--model", CIFAR_DEPLOY, "--weights", npz,
+            "--buckets", "1,8,32", "--bench", "500",
+            "--bench-sizes", "1,2,8,17,5", "--bench-concurrency", "8",
+        ]
+    )
+    assert rec["requests"] == 500 and rec["errors"] == 0
+    assert rec["metrics"]["errors"] == 0
+    assert rec["metrics"]["requests"] == 500
+    hist = rec["metrics"]["per_bucket"]
+    used = {b for b, e in hist.items() if e["batches"] > 0}
+    assert len(used) >= 2  # mixed sizes crossed buckets
+    for e in hist.values():
+        if e["batches"]:
+            assert e["device_latency"]["p50_ms"] is not None
