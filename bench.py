@@ -263,24 +263,41 @@ def bench_imagenet(
             ),
         )
 
-    def e2e_feed(mode: str):
+    def e2e_feed(mode: str, workers: int = 0):
         """Fresh host batches through the real preprocessing path,
-        device-prefetched — the end-to-end feed ImageNetApp trains on."""
+        device-prefetched — the end-to-end feed ImageNetApp trains on.
+        Returns ``(iterator, close_fn)``: the parallel mode owns worker
+        processes + shm slots that must be released after timing."""
         from sparknet_tpu.apps.cifar_app import make_native_feed
         from sparknet_tpu.apps.imagenet_app import make_device_feed, make_feed
         from sparknet_tpu.data.imagenet import imagenet_dataset
+        from sparknet_tpu.data.pipeline import default_data_workers
         from sparknet_tpu.data.prefetch import prefetch_to_device
 
         ds = imagenet_dataset(None, train=True, synthetic_n=max(2048, 2 * bs))
         # "native" -> C++ threaded prefetch loader; "device" -> uint8 +
-        # aug plan, pixels transformed on device; else host-python path
-        make = {
-            "native": make_native_feed, "device": make_device_feed
-        }.get(mode, make_feed)
-        return prefetch_to_device(make(ds, bench_tf, bs, seed=0), size=2)
+        # aug plan, pixels transformed on device; "parallel" -> the
+        # multiprocess host pipeline; else serial host-python path
+        if mode == "parallel":
+            inner = make_feed(
+                ds, bench_tf, bs, seed=0,
+                workers=workers or max(1, default_data_workers()),
+            )
+        else:
+            make = {
+                "native": make_native_feed, "device": make_device_feed
+            }.get(mode, make_feed)
+            inner = make(ds, bench_tf, bs, seed=0)
+        it = prefetch_to_device(inner, size=2)
+
+        def close():
+            it.close()
+            getattr(inner, "close", lambda: None)()
+
+        return it, close
 
     if end_to_end:
-        feed_iter = e2e_feed(pipeline_mode)
+        feed_iter, feed_close = e2e_feed(pipeline_mode)
         feed = lambda: feed_iter
     else:
         batch = {
@@ -321,6 +338,7 @@ def bench_imagenet(
         # or the halved run would OOM against our leftovers
         del solver, feed
         if end_to_end:
+            feed_close()
             del feed_iter
         else:
             del batch
@@ -344,6 +362,8 @@ def bench_imagenet(
     dt = _time_training(
         solver, None if end_to_end else batch, feed, iters, scanned
     )
+    if end_to_end:
+        feed_close()  # parallel feeds own worker processes + shm slots
 
     img_per_sec = bs * iters / dt
     tflops = flops_batch * iters / dt / 1e12
@@ -354,6 +374,11 @@ def bench_imagenet(
     # says nothing): a short host-fed, device-prefetched loop, reported
     # as a sub-record next to the compute-only headline so one bench
     # invocation answers "does the input pipeline keep the chip busy?"
+    # When preprocessing workers are available the sub-record carries a
+    # serial vs parallel A/B of the SAME batch stream.
+    from sparknet_tpu.data.pipeline import default_data_workers
+
+    pipeline_workers = default_data_workers()
     pipeline_record = pipeline_mode if end_to_end else False
     if (
         not end_to_end
@@ -365,20 +390,31 @@ def bench_imagenet(
     ):
         try:
             e2e_iters = max(4, iters // 4)
-            it = e2e_feed("1")
-            m = solver.step(it, 2)  # pipeline warmup
-            _fence(m)
-            t0 = time.perf_counter()
-            m = solver.step(it, e2e_iters)
-            _fence(m)
-            e2e_dt = time.perf_counter() - t0
-            e2e_ips = bs * e2e_iters / e2e_dt
+
+            def run_e2e(mode: str, workers: int = 0) -> float:
+                it, close = e2e_feed(mode, workers)
+                try:
+                    _fence(solver.step(it, 2))  # pipeline warmup
+                    t0 = time.perf_counter()
+                    _fence(solver.step(it, e2e_iters))
+                    return bs * e2e_iters / (time.perf_counter() - t0)
+                finally:
+                    close()
+
+            e2e_ips = run_e2e("1")
             pipeline_record = {
                 "mode": "python+prefetch",
                 "img_per_sec": round(e2e_ips, 2),
                 "iters": e2e_iters,
                 "vs_compute_only": round(e2e_ips / img_per_sec, 3),
             }
+            if pipeline_workers:
+                par_ips = run_e2e("parallel", pipeline_workers)
+                pipeline_record["parallel"] = {
+                    "workers": pipeline_workers,
+                    "img_per_sec": round(par_ips, 2),
+                    "vs_serial": round(par_ips / e2e_ips, 3),
+                }
         except Exception as e:  # never let the e2e extra kill the bench
             pipeline_record = {"error": f"{type(e).__name__}: {e}"}
 
@@ -404,6 +440,64 @@ def bench_imagenet(
         # proof); "loop" = one dispatch per iteration
         "timing": "scanned" if scanned else "loop",
         "input_pipeline": pipeline_record,
+        # preprocessing workers the parallel feed would use here
+        # (SPARKNET_DATA_WORKERS / cpu-count aware; 0 = serial host)
+        "input_pipeline_workers": pipeline_workers,
+    }
+
+
+def bench_input_pipeline(platform: str) -> dict:
+    """Host input-pipeline A/B: serial vs multiprocess preprocessing
+    (``BENCH_MODEL=input_pipeline``). No training — this drains the
+    AlexNet-shaped feed (256x256 uint8 source -> random 227 crop +
+    mirror + mean, float32 out) and measures host images/sec, so it runs
+    meaningfully on CPU where the training-loop sub-record can't. The
+    two streams are bit-identical (tests/test_pipeline.py proves it);
+    the record answers only "how much faster does the host produce
+    them?". Workers: SPARKNET_DATA_WORKERS, else cpu-count aware with a
+    floor of 2 so the A/B always exercises the multiprocess path."""
+    from sparknet_tpu.apps.imagenet_app import make_feed
+    from sparknet_tpu.data.imagenet import BGR_MEAN, imagenet_dataset
+    from sparknet_tpu.data.pipeline import default_data_workers
+    from sparknet_tpu.data.preprocess import Transformer
+
+    bs = int(os.environ.get("BENCH_BATCH", 32))
+    iters = int(os.environ.get("BENCH_ITERS", 16))
+    tf = Transformer(
+        mean_values=list(BGR_MEAN), crop_size=227, mirror=True, train=True
+    )
+    ds = imagenet_dataset(None, train=True, synthetic_n=max(512, 2 * bs))
+    workers = default_data_workers() or 2
+
+    def drain(feed) -> float:
+        for _ in range(2):  # warm partition decode + worker spin-up
+            next(feed)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            next(feed)
+        return bs * iters / (time.perf_counter() - t0)
+
+    serial_ips = drain(make_feed(ds, tf, bs, seed=0))
+    pipe = make_feed(ds, tf, bs, seed=0, workers=workers)
+    try:
+        parallel_ips = drain(pipe)
+        metrics = pipe.metrics.snapshot()
+    finally:
+        pipe.close()
+
+    return {
+        "metric": "input_pipeline_images_per_sec",
+        "value": round(parallel_ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "platform": platform,
+        "batch_size": bs,
+        "iters": iters,
+        "serial_img_per_sec": round(serial_ips, 2),
+        "speedup_vs_serial": round(parallel_ips / serial_ips, 3),
+        "input_pipeline_workers": workers,
+        "host_cpus": os.cpu_count(),
+        "pipeline_metrics": metrics,
     }
 
 
@@ -496,13 +590,16 @@ def main() -> None:
     profile_dir = os.environ.get("BENCH_PROFILE")
     if mode == "bert":
         runner = bench_bert
+    elif mode == "input_pipeline":
+        runner = bench_input_pipeline
     elif mode in IMAGENET_ARCHS:
         runner = functools.partial(bench_imagenet, arch=mode)
     else:
         # ValueError (not SystemExit): the __main__ wrapper catches
         # Exception and still emits the JSON error record
         raise ValueError(
-            f"BENCH_MODEL={mode!r}: want bert|{'|'.join(IMAGENET_ARCHS)}"
+            f"BENCH_MODEL={mode!r}: want "
+            f"bert|input_pipeline|{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
         with jax.profiler.trace(profile_dir):
@@ -534,6 +631,8 @@ if __name__ == "__main__":
                     "metric": (
                         "bert_base_mlm_tokens_per_sec_per_chip"
                         if bert
+                        else "input_pipeline_images_per_sec"
+                        if mode == "input_pipeline"
                         else f"{mode}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,

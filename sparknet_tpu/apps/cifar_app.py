@@ -101,18 +101,20 @@ def make_transformer(layer, train: bool, solver_dir: str, fallback_mean=None):
 
 
 def make_native_feed(
-    ds, transformer: Transformer, batch_size: int, seed: int = 0
+    ds, transformer: Transformer, batch_size: int, seed: int = 0,
+    workers: int = 0,
 ):
     """Feed served by the C++ prefetching loader (sparknet_tpu.native):
     shuffle + crop/mirror/mean + batch assembly in native worker threads,
     Python only memcpys ready batches. Falls back to :func:`make_feed`
-    when the library can't be built, or when the dataset won't fit the
+    (which honours ``workers`` — the multiprocess python pipeline) when
+    the library can't be built, or when the dataset won't fit the
     loader's in-RAM cache (it materialises every partition —
     ``SPARKNET_NATIVE_CACHE_MB``, default 2048, bounds that)."""
     from .. import native
 
     if not native.available():
-        return make_feed(ds, transformer, batch_size, seed)
+        return make_feed(ds, transformer, batch_size, seed, workers=workers)
     cap = float(os.environ.get("SPARKNET_NATIVE_CACHE_MB", "2048")) * 1e6
     parts, total = [], 0
     for i in range(ds.num_partitions):
@@ -124,7 +126,9 @@ def make_native_feed(
                 f"SPARKNET_NATIVE_CACHE_MB={cap / 1e6:.0f} — using the "
                 f"python feed (partitions stay lazy)"
             )
-            return make_feed(ds, transformer, batch_size, seed)
+            return make_feed(
+                ds, transformer, batch_size, seed, workers=workers
+            )
         parts.append(p)
     images = np.concatenate([p["data"] for p in parts])
     labels = np.concatenate([p["label"] for p in parts])
@@ -141,7 +145,8 @@ def make_native_feed(
 
 
 def make_feed(
-    ds, transformer: Transformer, batch_size: int, seed: int = 0
+    ds, transformer: Transformer, batch_size: int, seed: int = 0,
+    workers: int = 0,
 ) -> Iterator[Dict[str, jnp.ndarray]]:
     # host numpy out: placement is the solver's job (see imagenet_app)
     def transform(batch, rng):
@@ -150,6 +155,15 @@ def make_feed(
             "label": np.asarray(batch["label"], np.int32),
         }
 
+    if workers > 0:
+        # multiprocess assembly + preprocessing; the batch stream is
+        # bit-identical to the serial feed below for any worker count
+        from ..data.pipeline import ParallelBatchPipeline
+
+        return ParallelBatchPipeline(
+            ds, batch_size, workers=workers, shuffle=True, seed=seed,
+            transform=transform,
+        )
     return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
 
 
@@ -256,10 +270,34 @@ def build(args) -> tuple:
         if getattr(args, "native_loader", "auto") == "off"
         else make_native_feed  # auto/on: falls back if the lib won't build
     )
-    train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
+    workers = resolve_feed_workers(args, nproc)
+    train_feed = feed_fn(
+        train_ds, train_tf, feed_train_bs, seed=args.seed, workers=workers
+    )
+    # test feed stays serial: eval runs at test_interval cadence and its
+    # center-crop transform is cheap — not worth worker processes
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     record_loader_meta(solver, train_feed)
     return solver, train_feed, test_feed
+
+
+def resolve_feed_workers(args, nproc: int) -> int:
+    """Effective input-pipeline worker count for an app's train feed:
+    ``--data-workers`` / ``SPARKNET_DATA_WORKERS`` / cpu-count auto
+    (``data.pipeline.resolve_data_workers``). Auto stays serial under
+    multi-host (forking next to the coordinator/heartbeat fabric is only
+    done when asked explicitly); an explicit count is always honoured —
+    the batch stream is bit-identical either way, so the choice is about
+    throughput, never about results.  Shared by both image apps."""
+    from ..data.pipeline import resolve_data_workers
+
+    requested = getattr(args, "data_workers", -1)
+    workers = resolve_data_workers(requested)
+    if nproc > 1 and (requested is None or requested < 0):
+        return 0
+    if workers and multihost.is_primary():
+        print(f"data pipeline: {workers} preprocessing workers")
+    return workers
 
 
 def record_loader_meta(solver, train_feed) -> None:
@@ -397,6 +435,11 @@ def arg_parser() -> argparse.ArgumentParser:
                     choices=("auto", "on", "off"),
                     help="C++ prefetching data loader: auto (default — "
                          "use it when the library builds), on, or off")
+    ap.add_argument("--data-workers", type=int, default=-1,
+                    help="preprocessing worker processes for the train "
+                         "feed (-1 auto: SPARKNET_DATA_WORKERS or "
+                         "cpu-count aware; 0 serial). The batch stream "
+                         "is bit-identical for any count")
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
     ap.add_argument("--tau", type=int, default=10,
@@ -443,6 +486,7 @@ def main(argv=None):
     # which must stay host-side (and skippable), not device transfers
     from ..data.prefetch import maybe_prefetch
 
+    raw_train_feed = train_feed
     train_feed = maybe_prefetch(train_feed, args, args.parallel)
     if multihost.is_primary():
         if args.restore:
@@ -454,8 +498,17 @@ def main(argv=None):
         )
     from ..utils.profiling import trace
 
-    with trace(args.profile_dir):
-        result = train_loop(solver, train_feed, test_feed)
+    try:
+        with trace(args.profile_dir):
+            result = train_loop(solver, train_feed, test_feed)
+    finally:
+        # a multiprocess train feed owns worker processes + shm slots;
+        # stop them even when the loop raises (and report its per-stage
+        # waits — the host-bound vs device-bound answer — on the way out)
+        pm = getattr(raw_train_feed, "metrics", None)
+        if pm is not None and multihost.is_primary():
+            print(f"input pipeline: {pm.json_line()}")
+        getattr(raw_train_feed, "close", lambda: None)()
     # training is done: leave the liveness fabric gracefully so the
     # last host to finish isn't mistaken for a dead peer
     multihost.stop_heartbeat()

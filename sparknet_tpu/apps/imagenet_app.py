@@ -47,7 +47,8 @@ ARCH_SOLVERS = {
 
 
 def make_feed(
-    ds, transformer: Transformer, batch_size: int, seed: int = 0
+    ds, transformer: Transformer, batch_size: int, seed: int = 0,
+    workers: int = 0,
 ) -> Iterator[Dict[str, jnp.ndarray]]:
     # yield host numpy (not device arrays): the solver/device_put layer
     # owns placement, and pre-committed device arrays would force a
@@ -58,6 +59,15 @@ def make_feed(
             "label": np.asarray(batch["label"], np.int32),
         }
 
+    if workers > 0:
+        # multiprocess assembly + preprocessing (data/pipeline.py); the
+        # batch stream is bit-identical to the serial feed below
+        from ..data.pipeline import ParallelBatchPipeline
+
+        return ParallelBatchPipeline(
+            ds, batch_size, workers=workers, shuffle=True, seed=seed,
+            transform=transform,
+        )
     return ds.batches(batch_size, shuffle=True, seed=seed, transform=transform)
 
 
@@ -209,7 +219,18 @@ def build(args):
         feed_fn = make_feed
     else:
         feed_fn = make_native_feed  # auto/on: falls back if lib won't build
-    train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
+    if feed_fn is make_device_feed:
+        # device augmentation already cut the host work to shuffle +
+        # memcpy — worker processes would only add transport cost
+        train_feed = feed_fn(train_ds, train_tf, feed_train_bs, seed=args.seed)
+    else:
+        from .cifar_app import resolve_feed_workers
+
+        train_feed = feed_fn(
+            train_ds, train_tf, feed_train_bs, seed=args.seed,
+            workers=resolve_feed_workers(args, nproc),
+        )
+    # test feed stays serial (eval cadence; cheap center crop)
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     record_loader_meta(solver, train_feed)
     return solver, train_feed, test_feed
@@ -238,6 +259,11 @@ def parser() -> argparse.ArgumentParser:
                     choices=("auto", "on", "off"),
                     help="C++ prefetching data loader: auto (default — "
                          "use it when the library builds), on, or off")
+    ap.add_argument("--data-workers", type=int, default=-1,
+                    help="preprocessing worker processes for the train "
+                         "feed (-1 auto: SPARKNET_DATA_WORKERS or "
+                         "cpu-count aware; 0 serial). The batch stream "
+                         "is bit-identical for any count")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (TPU-native matmul dtype)")
     ap.add_argument("--remat", action="store_true",
@@ -282,6 +308,7 @@ def main(argv=None):
     # wrap AFTER restore (see cifar_app.main)
     from ..data.prefetch import maybe_prefetch
 
+    raw_train_feed = train_feed
     train_feed = maybe_prefetch(train_feed, args, args.parallel)
     if multihost.is_primary():
         if args.restore:
@@ -293,8 +320,16 @@ def main(argv=None):
         )
     from ..utils.profiling import trace
 
-    with trace(args.profile_dir):
-        result = train_loop(solver, train_feed, test_feed)
+    try:
+        with trace(args.profile_dir):
+            result = train_loop(solver, train_feed, test_feed)
+    finally:
+        # stop a multiprocess feed's workers/shm and report its waits
+        # (host-bound vs device-bound) — see cifar_app.main
+        pm = getattr(raw_train_feed, "metrics", None)
+        if pm is not None and multihost.is_primary():
+            print(f"input pipeline: {pm.json_line()}")
+        getattr(raw_train_feed, "close", lambda: None)()
     multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return result
 

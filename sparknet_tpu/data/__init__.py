@@ -1,0 +1,14 @@
+"""Data layer: the RDD-role ShardedDataset, loaders, preprocessing,
+and the two feed accelerators — ``prefetch`` (device-staging thread)
+and ``pipeline`` (multiprocess host preprocessing, docs/PIPELINE.md).
+Heavy imports stay in the submodules; this package only re-exports the
+names the apps and tools wire together."""
+
+from .pipeline import (  # noqa: F401
+    ParallelBatchPipeline,
+    PipelineMetrics,
+    default_data_workers,
+    resolve_data_workers,
+)
+from .prefetch import maybe_prefetch, prefetch_to_device  # noqa: F401
+from .rdd import BatchIterator, ShardedDataset  # noqa: F401

@@ -73,6 +73,34 @@ class LatencyHistogram:
         }
 
 
+class Gauge:
+    """Current value + high-water mark. The generic occupancy primitive
+    (queue depth, buffer fill, slots in flight) shared by the serving
+    metrics here and the input-pipeline metrics in ``data/pipeline.py``.
+    Lock-protected: producers, consumers and snapshot readers race."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self.max = 0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = v
+            if v > self.max:
+                self.max = v
+
+    def add(self, d) -> None:
+        with self._lock:
+            self.value += d
+            if self.value > self.max:
+                self.max = self.value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"value": self.value, "max": self.max}
+
+
 class ServeMetrics:
     """One registry per serving process. The engine reports device-side
     per-bucket execution, the batcher reports end-to-end request
@@ -86,8 +114,7 @@ class ServeMetrics:
         self.requests = 0
         self.rows = 0
         self.errors = 0
-        self.queue_depth = 0
-        self.queue_depth_max = 0
+        self._queue_depth = Gauge()
         self.request_latency = LatencyHistogram()
         self.per_bucket: Dict[int, dict] = {}
         for b in buckets:
@@ -127,9 +154,7 @@ class ServeMetrics:
             self.errors += n
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = depth
-            self.queue_depth_max = max(self.queue_depth_max, depth)
+        self._queue_depth.set(depth)
 
     # -------------------------------------------------------------- reads
     def snapshot(self) -> dict:
@@ -149,8 +174,8 @@ class ServeMetrics:
                 "window_requests_per_sec": round(
                     self._window_requests / window, 2
                 ),
-                "queue_depth": self.queue_depth,
-                "queue_depth_max": self.queue_depth_max,
+                "queue_depth": self._queue_depth.value,
+                "queue_depth_max": self._queue_depth.max,
                 "request_latency": self.request_latency.snapshot(),
                 "per_bucket": {
                     str(b): {

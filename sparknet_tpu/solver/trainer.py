@@ -458,11 +458,18 @@ class Solver:
         return batch
 
     def test(self, batches: Iterator[Dict[str, Any]], test_iter: Optional[int] = None):
+        """Caffe's TEST phase: ``test_iter`` eval batches, averaged.
+
+        Accumulates the metric sums as device arrays — each iteration
+        only ENQUEUES an eval step and an add, so host preprocessing of
+        batch i+1 overlaps device eval of batch i — and materialises the
+        floats once after the loop (a per-batch ``float(v)`` would fence
+        the device every iteration and serialise the whole eval)."""
         n = test_iter or (self.sp.test_iter[0] if self.sp.test_iter else 1)
-        acc: Dict[str, float] = {}
+        acc: Dict[str, Any] = {}
         for _ in range(n):
             batch = self._put_batch(next(batches), train=False)
             m = self._eval_step(self.params, self.state, batch)
             for k, v in m.items():
-                acc[k] = acc.get(k, 0.0) + float(v)
-        return {k: v / n for k, v in acc.items()}
+                acc[k] = v if k not in acc else acc[k] + v
+        return {k: float(v) / n for k, v in acc.items()}

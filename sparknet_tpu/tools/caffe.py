@@ -7,9 +7,14 @@
     python -m sparknet_tpu.tools.caffe time  --solver=s.prototxt [...]
 
 ``train`` routes to CifarApp's generic loop (any prototxt works — the
-app name is historical); ``time`` to tools/time_net; ``test`` builds
-the TEST-phase net and reports averaged metrics.  Both ``--flag=value``
-and ``--flag value`` spellings are accepted, like the original binary.
+app name is historical), so every app flag passes through — including
+``--data-workers=N`` / ``SPARKNET_DATA_WORKERS`` for the multiprocess
+input pipeline (docs/PIPELINE.md; the training run prints the
+pipeline's per-stage wait metrics on exit, the host-bound vs
+device-bound answer). ``time`` routes to tools/time_net; ``test``
+builds the TEST-phase net and reports averaged metrics.  Both
+``--flag=value`` and ``--flag value`` spellings are accepted, like the
+original binary.
 """
 
 from __future__ import annotations

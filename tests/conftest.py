@@ -60,3 +60,28 @@ if os.environ.get("SPARKNET_TEST_NO_CACHE", "") in ("", "0"):
         "jax_persistent_cache_min_entry_size_bytes",
         int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
     )
+
+
+import glob
+import multiprocessing
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="session")
+def assert_no_pipeline_leaks():
+    """Tier-1 runs on CPU and must stay leak-free: after the whole
+    session, no input-pipeline worker process may still be alive and no
+    shared-memory slot may survive in /dev/shm (data/pipeline.py names
+    both with the SHM_PREFIX, so stray ones are attributable)."""
+    yield
+    from sparknet_tpu.data.pipeline import SHM_PREFIX
+
+    stray = [
+        p for p in multiprocessing.active_children()
+        if p.name.startswith(SHM_PREFIX)
+    ]
+    assert not stray, f"input-pipeline workers leaked past tests: {stray}"
+    if os.path.isdir("/dev/shm"):
+        segs = glob.glob(f"/dev/shm/{SHM_PREFIX}_*")
+        assert not segs, f"shared-memory segments leaked past tests: {segs}"
