@@ -344,6 +344,11 @@ def train_loop(
         solver.save(state_path)
         if multihost.is_primary():
             W.save_npz(path, solver.params)
+            # keep-last-k (SPARKNET_SNAPSHOT_KEEP): bounds disk growth
+            # while leaving older snapshots for torn-file fallback
+            from ..solver.snapshot import prune_snapshots
+
+            prune_snapshots(sp.snapshot_prefix)
         log(f"Snapshotting to {path}")
         log(f"Snapshotting solver state to {state_path}")
 
@@ -459,6 +464,10 @@ def arg_parser() -> argparse.ArgumentParser:
                     default="npz",
                     help="solverstate on-disk format (orbax writes "
                          "sharded device arrays directly)")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic fault injection, e.g. "
+                         "'pipeline.worker_crash@batch=37:worker=1' "
+                         "(also SPARKNET_CHAOS; docs/ROBUSTNESS.md)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -471,6 +480,9 @@ def main(argv=None):
                                  description="CIFAR-10 training (CifarApp)")
     args = ap.parse_args(argv)
 
+    from .. import chaos
+
+    chaos.install_from(args.chaos)  # --chaos wins over SPARKNET_CHAOS
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
     from ..solver.snapshot import solverstate_suffix
@@ -481,7 +493,19 @@ def main(argv=None):
     solver.sp.snapshot_prefix = resolve_prefix(solver.sp.snapshot_prefix)
     apply_auto_resume(args, solver.sp.snapshot_prefix)
     if args.restore:
-        solver.restore(args.restore, train_feed)
+        if args.auto_resume:
+            # auto-resume owns the snapshot chain: a torn newest file
+            # falls back to the previous one instead of aborting
+            from ..solver.snapshot import restore_with_fallback
+
+            args.restore = restore_with_fallback(
+                solver, solver.sp.snapshot_prefix, args.restore,
+                feed=train_feed,
+            )
+        else:
+            # an explicitly-named --restore must fail loudly on a torn
+            # file: silently restoring something else isn't recovery
+            solver.restore(args.restore, train_feed)
     # wrap AFTER restore: align_feed fast-forwards skipped batches,
     # which must stay host-side (and skippable), not device transfers
     from ..data.prefetch import maybe_prefetch
@@ -509,6 +533,10 @@ def main(argv=None):
         if pm is not None and multihost.is_primary():
             print(f"input pipeline: {pm.json_line()}")
         getattr(raw_train_feed, "close", lambda: None)()
+        if chaos.active() and multihost.is_primary():
+            # fires + recoveries, one JSON line — the chaos run's
+            # observable record (tests assert exact counts on it)
+            print(f"chaos: {chaos.METRICS.json_line()}")
     # training is done: leave the liveness fabric gracefully so the
     # last host to finish isn't mistaken for a dead peer
     multihost.stop_heartbeat()

@@ -285,6 +285,10 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
                     default="npz",
                     help="solverstate on-disk format")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic fault injection, e.g. "
+                         "'pipeline.worker_crash@batch=37:worker=1' "
+                         "(also SPARKNET_CHAOS; docs/ROBUSTNESS.md)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -294,6 +298,9 @@ def main(argv=None):
 
     honor_platform_env()
     args = parser().parse_args(argv)
+    from .. import chaos
+
+    chaos.install_from(args.chaos)  # --chaos wins over SPARKNET_CHAOS
     multihost.initialize()  # no-op without SPARKNET_COORDINATOR
     solver, train_feed, test_feed = build(args)
     from ..solver.snapshot import solverstate_suffix
@@ -304,7 +311,16 @@ def main(argv=None):
     solver.sp.snapshot_prefix = resolve_prefix(solver.sp.snapshot_prefix)
     apply_auto_resume(args, solver.sp.snapshot_prefix)
     if args.restore:
-        solver.restore(args.restore, train_feed)
+        if args.auto_resume:
+            # torn newest snapshot -> previous one (see cifar_app.main)
+            from ..solver.snapshot import restore_with_fallback
+
+            args.restore = restore_with_fallback(
+                solver, solver.sp.snapshot_prefix, args.restore,
+                feed=train_feed,
+            )
+        else:
+            solver.restore(args.restore, train_feed)
     # wrap AFTER restore (see cifar_app.main)
     from ..data.prefetch import maybe_prefetch
 
@@ -330,6 +346,8 @@ def main(argv=None):
         if pm is not None and multihost.is_primary():
             print(f"input pipeline: {pm.json_line()}")
         getattr(raw_train_feed, "close", lambda: None)()
+        if chaos.active() and multihost.is_primary():
+            print(f"chaos: {chaos.METRICS.json_line()}")
     multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return result
 

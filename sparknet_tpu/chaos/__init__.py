@@ -1,0 +1,124 @@
+"""Chaos: deterministic fault injection + the self-healing contract.
+
+SparkNet's pitch leans on Spark re-running a dead executor's partition;
+the TensorFlow paper makes the same point for non-Spark stacks —
+recovery is checkpointing + restart discipline, and it must be
+*testable*.  This package is the testable half: a registry of named
+fault points (:data:`~sparknet_tpu.chaos.plan.FAULT_POINTS`) driven by
+a seeded, sequence-indexed :class:`~sparknet_tpu.chaos.plan.FaultPlan`
+parsed from ``--chaos`` / ``SPARKNET_CHAOS``::
+
+    SPARKNET_CHAOS=pipeline.worker_crash@batch=37:worker=1 \\
+        python -m sparknet_tpu.tools.caffe train --solver=... \\
+        --data-workers=2
+
+The surfaces the faults exercise heal instead of aborting:
+
+- a dead pipeline worker is respawned and the lost batches re-produced
+  bit-identically (``data/pipeline.py``);
+- ``serve.Client`` retries 503s/connection drops with capped backoff,
+  the micro-batcher sheds expired requests before compute
+  (``serve/``);
+- solverstate writes are atomic + verified, restore falls back to the
+  previous snapshot on a torn file (``solver/snapshot.py``).
+
+Disabled (no spec installed, env unset) the whole subsystem compiles
+down to ``get_plan() is None`` — call sites cache that None and pay a
+single attribute test on the hot path.  Every recovery increments the
+process-global :data:`METRICS` registry so healing is observable.
+
+See docs/ROBUSTNESS.md for the fault-point catalog, the spec grammar
+and the recovery semantics/budgets.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .metrics import METRICS, ChaosMetrics
+from .plan import FAULT_POINTS, FaultPlan, Rule
+
+__all__ = [
+    "FAULT_POINTS",
+    "FaultPlan",
+    "METRICS",
+    "ChaosMetrics",
+    "Rule",
+    "active",
+    "clear",
+    "get_plan",
+    "install",
+    "install_from",
+    "record_recovery",
+]
+
+_plan: Optional[FaultPlan] = None
+_installed = False  # an explicit install() wins over the env var
+_env_spec: Optional[str] = None
+
+
+def _env_seed() -> int:
+    return int(os.environ.get("SPARKNET_CHAOS_SEED", "0") or 0)
+
+
+def install(spec: Optional[str], seed: Optional[int] = None) -> Optional[FaultPlan]:
+    """Install a fault plan for this process (CLI ``--chaos`` wiring and
+    tests).  ``spec`` of None/"" disables chaos regardless of the env.
+    Forked children (pipeline workers) inherit the installed plan."""
+    global _plan, _installed
+    _installed = True
+    _plan = (
+        FaultPlan(spec, seed=_env_seed() if seed is None else seed)
+        if spec
+        else None
+    )
+    return _plan
+
+
+def install_from(flag: Optional[str]) -> Optional[FaultPlan]:
+    """App-side wiring: an explicit ``--chaos`` flag wins; otherwise
+    ``SPARKNET_CHAOS`` (resolved lazily by :func:`get_plan`)."""
+    if flag:
+        return install(flag)
+    return get_plan()
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active plan, or None when chaos is disabled.  Without an
+    explicit :func:`install`, ``SPARKNET_CHAOS`` is parsed on demand
+    (re-parsed only when the env value changes, so a CLI subprocess
+    needs zero wiring).  Call sites cache the result at construction
+    time — the disabled hot path is one ``is None`` test."""
+    global _plan, _env_spec
+    if _installed:
+        return _plan
+    spec = os.environ.get("SPARKNET_CHAOS", "").strip()
+    if not spec:
+        _plan, _env_spec = None, None
+        return None
+    if _plan is None or _env_spec != spec:
+        _env_spec = spec
+        _plan = FaultPlan(spec, seed=_env_seed())
+    return _plan
+
+
+def active() -> bool:
+    return get_plan() is not None
+
+
+def clear() -> None:
+    """Drop any installed/env-resolved plan and zero the metrics
+    (test isolation)."""
+    global _plan, _installed, _env_spec
+    _plan = None
+    _installed = False
+    _env_spec = None
+    METRICS.reset()
+
+
+def record_recovery(name: str) -> None:
+    """Count one recovery action (see :mod:`sparknet_tpu.chaos.metrics`).
+    Recorded unconditionally — recoveries from real faults (not just
+    injected ones) are equally worth surfacing."""
+    METRICS.record_recovery(name)

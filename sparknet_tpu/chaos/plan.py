@@ -1,0 +1,227 @@
+"""Fault plans: the chaos subsystem's deterministic "when to fail".
+
+A :class:`FaultPlan` is parsed from a spec string (``--chaos`` /
+``SPARKNET_CHAOS``) of comma-separated clauses::
+
+    point[@cond[:cond...]]
+
+where ``point`` names a registered fault point (:data:`FAULT_POINTS`)
+and each ``cond`` is ``key=value``.  Keys fall into three groups:
+
+- **coordinates** (``batch=37``, ``worker=1``, ``request=12``,
+  ``iter=500``, ``tick=3``, ``index=0``): exact-match predicates
+  against the coordinates the injection site passes.  A clause fires
+  only when every coordinate it names matches.
+- **schedule predicates**: ``p=0.25`` (seeded Bernoulli per index),
+  ``every=2`` (index % every == 0), ``after=10`` (index >= after),
+  ``times=3`` (at most N fires per process), ``seed=7`` (per-clause
+  override of the plan seed).  The "index" these use is the site's
+  primary sequence coordinate — the first of ``batch``, ``request``,
+  ``iter``, ``tick``, ``index`` present in the call.
+- **parameters** (``delay_ms=50``, ``exit_code=3``, ``frac=0.5``):
+  carried on the matched rule for the site to interpret; never
+  predicates.
+
+Determinism: probabilistic decisions draw from
+``np.random.default_rng((seed, crc32(point), index))`` — the same
+seed + spec + coordinate stream reproduces the same fault sequence on
+every run and every host, which is what makes chaos tests assertable.
+
+Examples::
+
+    pipeline.worker_crash@batch=37:worker=1
+    serve.conn_drop@every=2,serve.engine_stall@p=0.1:delay_ms=80
+    snapshot.partial_write@index=1:frac=0.5
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# The registry: every injectable fault point in the system. Sites pass
+# the coordinates listed here; the spec parser rejects unknown points
+# so a typo fails at launch, not silently never-fires.
+FAULT_POINTS: Dict[str, str] = {
+    "pipeline.worker_crash": (
+        "input-pipeline worker hard-exits (os._exit) before producing a "
+        "batch; coords: batch (global index), worker (rank); params: "
+        "exit_code"
+    ),
+    "pipeline.slow_batch": (
+        "input-pipeline worker stalls before producing a batch; coords: "
+        "batch, worker; params: delay_ms (default 50)"
+    ),
+    "serve.conn_drop": (
+        "HTTP server drops a /classify connection with no response; "
+        "coords: request (per-server POST index)"
+    ),
+    "serve.engine_stall": (
+        "micro-batcher stalls before an engine call; coords: batch "
+        "(per-batcher flush index); params: delay_ms (default 50)"
+    ),
+    "snapshot.partial_write": (
+        "solverstate write publishes a torn (truncated) file; coords: "
+        "index (per-process save count), iter (parsed from the path); "
+        "params: frac (default 0.5)"
+    ),
+    "multihost.peer_silence": (
+        "heartbeat client goes silent (peer appears dead to the "
+        "fabric); coords: worker (process id), tick (ping count)"
+    ),
+}
+
+# which coordinate serves as the schedule index, in priority order
+_INDEX_COORDS = ("batch", "request", "iter", "tick", "index")
+_SCHEDULE_KEYS = {"p", "every", "after", "times", "seed"}
+_PARAM_KEYS = {"delay_ms", "exit_code", "frac"}
+
+
+def _parse_value(point: str, key: str, raw: str):
+    try:
+        return int(raw)
+    except ValueError:
+        try:
+            return float(raw)
+        except ValueError:
+            raise ValueError(
+                f"chaos spec: {point}@{key}={raw!r} — value must be a "
+                f"number"
+            ) from None
+
+
+class Rule:
+    """One parsed clause: predicates + parameters + a fire budget."""
+
+    __slots__ = ("point", "match", "p", "every", "after", "times", "seed",
+                 "params", "fired")
+
+    def __init__(self, point: str, conds: Dict[str, float]):
+        self.point = point
+        self.match: Dict[str, int] = {}
+        self.p: Optional[float] = None
+        self.every: Optional[int] = None
+        self.after: Optional[int] = None
+        self.times: Optional[int] = None
+        self.seed: Optional[int] = None
+        self.params: Dict[str, float] = {}
+        self.fired = 0
+        for k, v in conds.items():
+            if k in _PARAM_KEYS:
+                self.params[k] = v
+            elif k == "p":
+                if not 0.0 < float(v) <= 1.0:
+                    raise ValueError(
+                        f"chaos spec: {point}@p={v} — p must be in (0, 1]"
+                    )
+                self.p = float(v)
+            elif k == "every":
+                if int(v) < 1:
+                    raise ValueError(f"chaos spec: {point}@every={v} < 1")
+                self.every = int(v)
+            elif k == "after":
+                self.after = int(v)
+            elif k == "times":
+                if int(v) < 1:
+                    raise ValueError(f"chaos spec: {point}@times={v} < 1")
+                self.times = int(v)
+            elif k == "seed":
+                self.seed = int(v)
+            else:
+                # anything else is an exact coordinate match
+                self.match[k] = int(v)
+
+    def _index(self, coords: Dict[str, int]) -> Optional[int]:
+        for k in _INDEX_COORDS:
+            if k in coords:
+                return int(coords[k])
+        return None
+
+    def decide(self, plan_seed: int, coords: Dict[str, int]) -> bool:
+        """Does this rule fire at these coordinates?  Mutates the fire
+        budget on a hit (caller holds the plan lock)."""
+        if self.times is not None and self.fired >= self.times:
+            return False
+        for k, want in self.match.items():
+            if coords.get(k) != want:
+                return False
+        idx = self._index(coords)
+        if self.after is not None and (idx is None or idx < self.after):
+            return False
+        if self.every is not None and (idx is None or idx % self.every):
+            return False
+        if self.p is not None:
+            seed = self.seed if self.seed is not None else plan_seed
+            draw = np.random.default_rng(
+                (seed, zlib.crc32(self.point.encode()), idx or 0)
+            ).random()
+            if draw >= self.p:
+                return False
+        self.fired += 1
+        return True
+
+
+class FaultPlan:
+    """A parsed chaos spec: ordered rules grouped by fault point.
+
+    ``match(point, **coords)`` returns the first rule that fires (and
+    records the fire in the chaos metrics), or None.  Sites that only
+    need a boolean use ``fires(...)``.  Call sites are expected to hold
+    a *cached* plan reference (or None) so the disabled path is a
+    single ``is None`` check — the zero-hot-path-cost contract.
+    """
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._by_point: Dict[str, List[Rule]] = {}
+        for clause in filter(None, (c.strip() for c in spec.split(","))):
+            point, _, tail = clause.partition("@")
+            point = point.strip()
+            if point not in FAULT_POINTS:
+                known = ", ".join(sorted(FAULT_POINTS))
+                raise ValueError(
+                    f"chaos spec: unknown fault point {point!r} "
+                    f"(known: {known})"
+                )
+            conds: Dict[str, float] = {}
+            if tail:
+                for cond in tail.split(":"):
+                    key, eq, raw = cond.partition("=")
+                    if not eq or not key.strip():
+                        raise ValueError(
+                            f"chaos spec: bad condition {cond!r} in "
+                            f"{clause!r} (want key=value)"
+                        )
+                    conds[key.strip()] = _parse_value(
+                        point, key.strip(), raw.strip()
+                    )
+            self._by_point.setdefault(point, []).append(Rule(point, conds))
+        if not self._by_point:
+            raise ValueError(f"chaos spec {spec!r} names no fault points")
+
+    def points(self):
+        return sorted(self._by_point)
+
+    def match(self, point: str, **coords) -> Optional[Rule]:
+        rules = self._by_point.get(point)
+        if not rules:
+            return None
+        with self._lock:
+            for rule in rules:
+                if rule.decide(self.seed, coords):
+                    from .metrics import METRICS
+
+                    METRICS.record_fire(point)
+                    return rule
+        return None
+
+    def fires(self, point: str, **coords) -> bool:
+        return self.match(point, **coords) is not None
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.spec!r}, seed={self.seed})"

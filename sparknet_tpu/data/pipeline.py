@@ -41,7 +41,21 @@ the batch stream:
 - **Shutdown.** ``close()`` (also ``with``-exit, generator-style
   ``__del__``) stops the workers, joins them, and unlinks every
   shared-memory segment — tier-1 CI asserts no stray children or
-  ``/dev/shm`` segments survive the tests.
+  ``/dev/shm`` segments survive the tests.  A worker that ignores
+  ``terminate()`` (wedged in C code) is escalated to ``kill()`` so a
+  stuck child can never hang interpreter exit.
+- **Self-healing.** The consumer supervises the workers: a rank that
+  dies silently (nonzero exitcode, closed pipe — e.g. OOM-kill, or the
+  ``pipeline.worker_crash`` chaos point) is respawned at the first
+  batch it never delivered, and the per-batch-index RNG re-produces
+  the lost batches bit-identically, so a crash costs latency, never
+  correctness.  Respawns are budgeted (``SPARKNET_PIPELINE_RESPAWNS``
+  per rank, default 2) with exponential backoff; past the budget the
+  failure surfaces at its serial stream position exactly as before.
+  A worker that *raises* (deterministic transform bug) still re-raises
+  at its serial position — respawning would just hit the same bug.
+  Every respawn increments ``PipelineMetrics.worker_respawns`` and the
+  chaos registry's ``pipeline.worker_respawn`` recovery counter.
 - **Observability.** :class:`PipelineMetrics` reuses the serving
   gauge/histogram primitives (``serve/metrics.py``) to expose per-stage
   wait time (worker blocked on a free slot; consumer blocked waiting
@@ -69,6 +83,7 @@ import multiprocessing as mp
 import os
 import pickle
 import queue as _queue
+import sys
 import threading
 import time
 import traceback
@@ -124,6 +139,7 @@ class PipelineMetrics:
         self.batches = 0
         self.rows = 0
         self.shm_fallbacks = 0
+        self.worker_respawns = 0
         self.produce = LatencyHistogram()
         self.worker_wait = LatencyHistogram()
         self.consumer_wait = LatencyHistogram()
@@ -147,6 +163,10 @@ class PipelineMetrics:
         with self._lock:
             self.consumer_wait.observe(seconds)
 
+    def record_respawn(self) -> None:
+        with self._lock:
+            self.worker_respawns += 1
+
     # -------------------------------------------------------------- reads
     def snapshot(self) -> dict:
         with self._lock:
@@ -157,6 +177,7 @@ class PipelineMetrics:
                 "rows": self.rows,
                 "rows_per_sec": round(self.rows / dt, 2),
                 "shm_fallbacks": self.shm_fallbacks,
+                "worker_respawns": self.worker_respawns,
                 "produce": self.produce.snapshot(),
                 "worker_wait": self.worker_wait.snapshot(),
                 "consumer_wait": self.consumer_wait.snapshot(),
@@ -182,19 +203,45 @@ def _layout(arrs: Dict[str, np.ndarray]):
 
 
 def _worker_main(
-    rank, workers, start_index, ds, batch_kw, transform, slot_bytes,
-    stop, free_q, result_q,
+    rank, workers, first_seq, ds, batch_kw, transform, slot_bytes,
+    stop, free_q, result_q, chaos_on=True,
 ):
     """One preprocessing worker: the serial batch enumeration with all
     batches not congruent to ``rank`` slice-skipped (never transformed),
     so this worker's transform RNG draws are exactly the serial feed's
-    for its indices. Ships each batch through a shared-memory slot."""
+    for its indices. Ships each batch through a shared-memory slot.
+    ``first_seq`` is the first global batch index this worker produces
+    (stride ``workers``) — a respawned worker resumes mid-stream at the
+    first batch its predecessor never delivered.  ``chaos_on=False``
+    disarms fault injection (respawned workers: the fault already
+    killed the process once; re-firing at the same deterministic batch
+    would crash-loop straight through the respawn budget)."""
+    plan = None
+    if chaos_on:
+        from .. import chaos as _chaos
+
+        plan = _chaos.get_plan()  # fork inherits the parent's plan
     shms: Dict[str, shared_memory.SharedMemory] = {}
     try:
         it = ds.batches(**batch_kw, transform=transform)
-        it.skip(start_index + rank)
-        seq = start_index + rank
+        it.skip(first_seq)
+        seq = first_seq
         while not stop.is_set():
+            if plan is not None:
+                rule = plan.match(
+                    "pipeline.worker_crash", batch=seq, worker=rank
+                )
+                if rule is not None:
+                    # hard death, no goodbye message: the supervisor
+                    # must detect it from the exitcode/closed pipe
+                    os._exit(int(rule.params.get("exit_code", 3)))
+                rule = plan.match(
+                    "pipeline.slow_batch", batch=seq, worker=rank
+                )
+                if rule is not None:
+                    time.sleep(
+                        float(rule.params.get("delay_ms", 50.0)) / 1e3
+                    )
             t0 = time.perf_counter()
             try:
                 batch = next(it)
@@ -265,7 +312,10 @@ class ParallelBatchPipeline:
     ``depth`` is the number of shared-memory slots per worker (the ring
     size — total staged batches are bounded by ``workers * depth``).
     ``slot_bytes`` overrides the probe-derived slot size (tests use a
-    tiny value to force the pickle fallback path).
+    tiny value to force the pickle fallback path).  ``max_respawns``
+    bounds per-rank recoveries from silent worker death (default
+    ``SPARKNET_PIPELINE_RESPAWNS``, 2); past it the death re-raises at
+    its serial stream position.
     """
 
     def __init__(
@@ -282,6 +332,7 @@ class ParallelBatchPipeline:
         depth: int = 2,
         slot_bytes: Optional[int] = None,
         metrics: Optional[PipelineMetrics] = None,
+        max_respawns: Optional[int] = None,
     ):
         if workers < 1:
             raise ValueError(
@@ -315,6 +366,12 @@ class ParallelBatchPipeline:
         self._errors: Dict[int, str] = {}
         self._procs: list = []
         self._shms: Dict[str, shared_memory.SharedMemory] = {}
+        self._max_respawns = (
+            max_respawns
+            if max_respawns is not None
+            else int(os.environ.get("SPARKNET_PIPELINE_RESPAWNS", "2") or 0)
+        )
+        self._respawns: Dict[int, int] = {}
 
     # ------------------------------------------------------------ control
     def skip(self, n: int) -> None:
@@ -367,40 +424,48 @@ class ParallelBatchPipeline:
         # docstring's backpressure contract
         self._free_qs = [self._ctx.Queue() for _ in range(self.workers)]
         self._result_q = self._ctx.Queue()
-        token = os.urandom(4).hex()
+        self._token = os.urandom(4).hex()
         for r in range(self.workers):
             for i in range(self._depth):
-                name = f"{SHM_PREFIX}_{os.getpid()}_{token}_{r}_{i}"
+                name = f"{SHM_PREFIX}_{os.getpid()}_{self._token}_{r}_{i}"
                 self._shms[name] = shared_memory.SharedMemory(
                     name=name, create=True, size=slot_bytes
                 )
                 self._free_qs[r].put(name)
         self.metrics.slots_free.set(self.workers * self._depth)
         self._worker_base = base + 1
+        for r in range(self.workers):
+            self._procs.append(
+                self._spawn_worker(
+                    r, self._worker_base + r, chaos_on=True,
+                    name=f"{SHM_PREFIX}-worker-{r}",
+                )
+            )
+
+    def _spawn_worker(self, rank, first_seq, chaos_on, name):
         import warnings
 
-        for r in range(self.workers):
-            p = self._ctx.Process(
-                target=_worker_main,
-                args=(
-                    r, self.workers, self._worker_base, self._ds,
-                    dict(self._batch_kw, batch_size=self._batch_size),
-                    self._transform, slot_bytes, self._stop,
-                    self._free_qs[r], self._result_q,
-                ),
-                daemon=True,
-                name=f"{SHM_PREFIX}-worker-{r}",
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(
+                rank, self.workers, first_seq, self._ds,
+                dict(self._batch_kw, batch_size=self._batch_size),
+                self._transform, self._slot_bytes, self._stop,
+                self._free_qs[rank], self._result_q, chaos_on,
+            ),
+            daemon=True,
+            name=name,
+        )
+        with warnings.catch_warnings():
+            # jax warns that fork + its threads can deadlock; the
+            # workers never call into jax (numpy + mp queues only),
+            # which is the one case the warning doesn't cover
+            warnings.filterwarnings(
+                "ignore", message=r"os\.fork\(\) was called",
+                category=RuntimeWarning,
             )
-            with warnings.catch_warnings():
-                # jax warns that fork + its threads can deadlock; the
-                # workers never call into jax (numpy + mp queues only),
-                # which is the one case the warning doesn't cover
-                warnings.filterwarnings(
-                    "ignore", message=r"os\.fork\(\) was called",
-                    category=RuntimeWarning,
-                )
-                p.start()
-            self._procs.append(p)
+            p.start()
+        return p
 
     # ---------------------------------------------------------- iteration
     def __iter__(self) -> Iterator[Any]:
@@ -465,20 +530,76 @@ class ParallelBatchPipeline:
                 msg = self._result_q.get(timeout=1.0)
             except _queue.Empty:
                 # the worker owning the awaited sequence number died
-                # without a word (kill -9 — a crash raises through the
-                # "err" message instead): fail instead of hanging
+                # without a word (kill -9, OOM, chaos worker_crash — a
+                # transform exception raises through the "err" message
+                # instead): respawn it and re-produce the lost batches
+                # deterministically; past the budget, fail at the
+                # serial position instead of hanging
                 if (
                     not self._procs[owner].is_alive()
                     and self._result_q.empty()
                 ):
-                    self.close()
-                    raise RuntimeError(
-                        f"input pipeline worker {owner} exited without "
-                        f"finishing the stream (awaiting batch "
-                        f"{self._next_seq})"
-                    )
+                    if not self._respawn(owner):
+                        exitcode = self._procs[owner].exitcode
+                        self.close()
+                        raise RuntimeError(
+                            f"input pipeline worker {owner} exited "
+                            f"(code {exitcode}) without finishing the "
+                            f"stream (awaiting batch {self._next_seq}; "
+                            f"{self._respawns.get(owner, 0)} respawns "
+                            f"already spent)"
+                        )
                 continue
             self._handle(msg)
+
+    def _respawn(self, owner: int) -> bool:
+        """Replace a silently-dead worker: new process, same rank,
+        resuming at the first batch the dead one never delivered (its
+        shipping is in-order, so that is the first owner-congruent
+        sequence number at/after the consumer cursor that isn't parked
+        in the reorder buffer).  The per-batch-index RNG makes the
+        re-produced batches bit-identical to what the dead worker would
+        have sent.  Bounded per rank; exponential backoff between
+        attempts so a crash loop can't busy-spin the host."""
+        n = self._respawns.get(owner, 0)
+        if n >= self._max_respawns:
+            return False
+        self._respawns[owner] = n + 1
+        exitcode = self._procs[owner].exitcode
+        time.sleep(min(2.0, 0.05 * (2 ** n)))
+        seq = self._next_seq
+        while self._owner(seq) != owner:
+            seq += 1
+        while seq in self._buffer:
+            seq += self.workers
+        # the dead worker may have died holding one popped-but-unshipped
+        # slot; add a replacement so its ring keeps `depth` slots (a
+        # message already in flight instead resolves as a duplicate —
+        # see _handle — and returns its slot there)
+        name = (
+            f"{SHM_PREFIX}_{os.getpid()}_{self._token}_{owner}"
+            f"_r{self._respawns[owner]}"
+        )
+        self._shms[name] = shared_memory.SharedMemory(
+            name=name, create=True, size=self._slot_bytes
+        )
+        self._free_qs[owner].put(name)
+        self.metrics.slots_free.add(1)
+        self._procs[owner] = self._spawn_worker(
+            owner, seq, chaos_on=False,
+            name=f"{SHM_PREFIX}-worker-{owner}-r{self._respawns[owner]}",
+        )
+        self.metrics.record_respawn()
+        from .. import chaos
+
+        chaos.record_recovery("pipeline.worker_respawn")
+        print(
+            f"input pipeline: worker {owner} died (exit {exitcode}); "
+            f"respawned at batch {seq} "
+            f"(attempt {self._respawns[owner]}/{self._max_respawns})",
+            file=sys.stderr, flush=True,
+        )
+        return True
 
     def _materialize(self, entry, owner: int):
         """Buffer entry -> batch dict. Slot-backed entries memcpy out
@@ -503,6 +624,14 @@ class ParallelBatchPipeline:
         kind = msg[0]
         if kind == "b":
             _, seq, slot, payload, produce_s, wait_s, rows = msg
+            if seq < self._next_seq or seq in self._buffer:
+                # duplicate after a respawn race: the dead worker's
+                # message was still in the queue pipe when the respawn
+                # re-produced the batch. Drop it — but hand the slot
+                # back, or the ring loses capacity
+                if slot is not None:
+                    self._free_qs[self._owner(seq)].put(slot)
+                return
             if slot is None:
                 self._buffer[seq] = (None, pickle.loads(payload))
             else:
@@ -538,7 +667,14 @@ class ParallelBatchPipeline:
         for p in self._procs:
             if p.is_alive():
                 p.terminate()
-                p.join(timeout=10)
+                p.join(timeout=5)
+            if p.is_alive():
+                # SIGTERM ignored (worker wedged in uninterruptible C
+                # code): escalate to SIGKILL — a stuck child must never
+                # hang interpreter exit (the CI leak fixture relies on
+                # close() actually reaping)
+                p.kill()
+                p.join(timeout=5)
         for q in [getattr(self, "_result_q", None)] + list(
             getattr(self, "_free_qs", [])
         ):

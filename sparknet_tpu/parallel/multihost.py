@@ -102,6 +102,7 @@ class _Heartbeat:
         self._server = None
         self._disarmed = False  # set when process 0 announced clean end
         self._ending = False  # process 0: close() underway, answer "end"
+        self._silent = False  # chaos multihost.peer_silence engaged
         if pid == 0:
             self._last_seen = {}
             self._expected = set(range(1, nprocs))
@@ -207,7 +208,25 @@ class _Heartbeat:
         joined = False
         conn = None
         ping = struct.pack("!i", self.pid)
+        from .. import chaos as _chaos
+
+        plan = _chaos.get_plan()
+        tick = 0
         while not self._stop.is_set():
+            if plan is not None and not self._silent and plan.fires(
+                "multihost.peer_silence", worker=self.pid, tick=tick
+            ):
+                # simulate a dead/isolated peer: stop pinging but stay
+                # alive, and disarm the local watchdog so detection is
+                # process 0's job — the fabric must fail the whole job
+                # (EXIT_PEER_FAILURE) and the launcher's relaunch +
+                # --auto-resume is the restart-level recovery
+                self._silent = True
+                self._disarmed = True
+            tick += 1
+            if self._silent:
+                self._stop.wait(self.interval)
+                continue
             if conn is None:
                 try:
                     conn = socket.create_connection(

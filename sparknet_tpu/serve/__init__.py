@@ -25,15 +25,17 @@ engine behind a batched request queue:
 See docs/SERVING.md for the architecture and knob reference.
 """
 
-from .batcher import Backpressure, MicroBatcher
+from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .engine import InferenceEngine
 from .loadgen import run_loadgen
-from .metrics import LatencyHistogram, ServeMetrics
+from .metrics import Counter, LatencyHistogram, ServeMetrics
 from .server import Client, InferenceServer
 
 __all__ = [
     "Backpressure",
     "Client",
+    "Counter",
+    "DeadlineExceeded",
     "InferenceEngine",
     "InferenceServer",
     "LatencyHistogram",

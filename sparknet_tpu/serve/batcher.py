@@ -13,7 +13,17 @@ A single worker thread is deliberate: the engine serializes on one
 device anyway, and one consumer keeps request ordering FIFO.
 ``drain()`` stops intake, lets the worker finish everything queued,
 and joins it — the graceful-shutdown path the server and the load
-generator both use.
+generator both use; a worker still alive past the join timeout raises
+instead of silently abandoning in-flight requests.
+
+Requests can carry a **deadline** (``deadline_s``, per-batcher default
+or per-submit): at flush time, expired requests are shed *before*
+compute — their futures fail with :class:`DeadlineExceeded`, the shed
+count marks the server degraded in ``/healthz`` — and requests whose
+future was cancelled by the caller (the HTTP handler's 504 path) are
+dropped the same way, so the device never computes a reply nobody
+reads.  The ``serve.engine_stall`` chaos point injects a stall right
+before the engine call to make both paths testable.
 """
 
 from __future__ import annotations
@@ -31,14 +41,22 @@ class Backpressure(RuntimeError):
     """Raised by submit() when the bounded request queue is full."""
 
 
-class _Pending:
-    __slots__ = ("rows", "n", "future", "t_enq")
+class DeadlineExceeded(RuntimeError):
+    """A request's deadline expired while it waited in the queue; it
+    was shed before reaching the engine."""
 
-    def __init__(self, rows: np.ndarray):
+
+class _Pending:
+    __slots__ = ("rows", "n", "future", "t_enq", "deadline")
+
+    def __init__(self, rows: np.ndarray, deadline_s: Optional[float] = None):
         self.rows = rows
         self.n = len(rows)
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
+        self.deadline = (
+            None if deadline_s is None else self.t_enq + deadline_s
+        )
 
 
 class MicroBatcher:
@@ -49,6 +67,7 @@ class MicroBatcher:
         max_batch: int = 0,
         max_latency_us: int = 2000,
         max_queue: int = 256,
+        deadline_s: Optional[float] = None,
         metrics=None,
     ):
         """``engine``: anything with ``infer(rows) -> rows`` (the
@@ -56,13 +75,21 @@ class MicroBatcher:
         budget per engine call — defaults to the engine's largest
         bucket. ``max_latency_us``: longest the oldest queued request
         waits for co-riders before the batch is flushed anyway.
-        ``max_queue``: bound on queued requests (backpressure)."""
+        ``max_queue``: bound on queued requests (backpressure).
+        ``deadline_s``: default per-request deadline — a request still
+        queued past it is shed before compute (None disables)."""
+        from .. import chaos
+
         self.engine = engine
         self.max_batch = int(max_batch) or max(
             getattr(engine, "buckets", (32,))
         )
         self.max_latency_s = max_latency_us / 1e6
+        self.deadline_s = deadline_s
         self.metrics = metrics
+        # cached once: the disabled chaos path is one `is None` test
+        self._chaos = chaos.get_plan()
+        self._flushes = 0
         self._q: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
         self._open = True
         self._worker = threading.Thread(
@@ -77,14 +104,19 @@ class MicroBatcher:
         *,
         block: bool = False,
         timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> Future:
         """Enqueue one request of N rows; resolves to the engine output
         for exactly those rows. ``block=False`` (the server's mode)
         raises :class:`Backpressure` when the queue is full; closed-loop
-        clients pass ``block=True`` to wait for room instead."""
+        clients pass ``block=True`` to wait for room instead.
+        ``deadline_s`` overrides the batcher-level default deadline."""
         if not self._open:
             raise RuntimeError("MicroBatcher is drained/closed")
-        item = _Pending(np.asarray(rows))
+        item = _Pending(
+            np.asarray(rows),
+            self.deadline_s if deadline_s is None else deadline_s,
+        )
         if item.n == 0:
             raise ValueError("submit: empty request")
         try:
@@ -124,6 +156,35 @@ class MicroBatcher:
             self._run(batch, total)
 
     def _run(self, batch: List[_Pending], total: int) -> None:
+        if self._chaos is not None:
+            rule = self._chaos.match("serve.engine_stall", batch=self._flushes)
+            if rule is not None:
+                time.sleep(float(rule.params.get("delay_ms", 50.0)) / 1e3)
+        self._flushes += 1
+        # shed-before-compute: expired deadlines fail fast, futures the
+        # caller already cancelled (server 504 path) are dropped — the
+        # engine never computes a reply nobody reads
+        now = time.perf_counter()
+        live: List[_Pending] = []
+        shed = cancelled = 0
+        for it in batch:
+            if it.deadline is not None and now > it.deadline:
+                shed += 1
+                it.future.set_exception(DeadlineExceeded(
+                    f"request expired after {now - it.t_enq:.3f}s in queue"
+                ))
+            elif not it.future.set_running_or_notify_cancel():
+                cancelled += 1
+            else:
+                live.append(it)
+        if self.metrics is not None:
+            if shed:
+                self.metrics.record_shed(shed)
+            if cancelled:
+                self.metrics.record_cancelled(cancelled)
+        if not live:
+            return
+        batch = live
         try:
             if len(batch) == 1:
                 out = self.engine.infer(batch[0].rows)
@@ -150,8 +211,16 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     def drain(self, timeout: Optional[float] = 30.0) -> None:
         """Graceful shutdown: refuse new requests, finish every queued
-        one, stop the worker. Idempotent."""
+        one, stop the worker. Idempotent.  A worker still alive past
+        the join timeout (engine wedged mid-call) raises — returning
+        silently would abandon in-flight requests whose futures never
+        resolve."""
         self._open = False
         self._worker.join(timeout)
+        if self._worker.is_alive():
+            raise RuntimeError(
+                f"MicroBatcher worker did not stop within {timeout}s "
+                f"(engine wedged?) — requests may still be in flight"
+            )
 
     close = drain

@@ -73,6 +73,24 @@ class LatencyHistogram:
         }
 
 
+class Counter:
+    """Lock-protected monotone event counter — the simplest shared
+    primitive (chaos fires/recoveries, shed requests).  Gauge tracks a
+    level; Counter only ever goes up."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def inc(self, d: int = 1) -> None:
+        with self._lock:
+            self.n += d
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return self.n
+
+
 class Gauge:
     """Current value + high-water mark. The generic occupancy primitive
     (queue depth, buffer fill, slots in flight) shared by the serving
@@ -114,6 +132,13 @@ class ServeMetrics:
         self.requests = 0
         self.rows = 0
         self.errors = 0
+        # requests dropped without compute: shed = expired deadline,
+        # cancelled = abandoned by the caller (e.g. the HTTP handler's
+        # timeout).  Either marks the server degraded for a window —
+        # /healthz surfaces it so a balancer can back off.
+        self.shed = 0
+        self.cancelled = 0
+        self._last_degraded_t: float = float("-inf")
         self._queue_depth = Gauge()
         self.request_latency = LatencyHistogram()
         self.per_bucket: Dict[int, dict] = {}
@@ -153,8 +178,33 @@ class ServeMetrics:
         with self._lock:
             self.errors += n
 
+    def record_shed(self, n: int = 1) -> None:
+        """Requests whose deadline expired before compute."""
+        with self._lock:
+            self.shed += n
+            self._last_degraded_t = time.perf_counter()
+
+    def record_cancelled(self, n: int = 1) -> None:
+        """Requests abandoned by their caller before compute."""
+        with self._lock:
+            self.cancelled += n
+            self._last_degraded_t = time.perf_counter()
+
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(depth)
+
+    # ------------------------------------------------------------- health
+    DEGRADED_WINDOW_S = 60.0
+
+    def health(self) -> str:
+        """"ok" or "degraded": degraded while a shed/cancelled request
+        happened within the last window — load is outrunning the
+        deadline budget, so /healthz tells balancers to back off."""
+        with self._lock:
+            t = self._last_degraded_t
+        if time.perf_counter() - t < self.DEGRADED_WINDOW_S:
+            return "degraded"
+        return "ok"
 
     # -------------------------------------------------------------- reads
     def snapshot(self) -> dict:
@@ -170,6 +220,13 @@ class ServeMetrics:
                 "requests": self.requests,
                 "rows": self.rows,
                 "errors": self.errors,
+                "shed": self.shed,
+                "cancelled": self.cancelled,
+                "health": (
+                    "degraded"
+                    if now - self._last_degraded_t < self.DEGRADED_WINDOW_S
+                    else "ok"
+                ),
                 "requests_per_sec": round(self.requests / uptime, 2),
                 "window_requests_per_sec": round(
                     self._window_requests / window, 2

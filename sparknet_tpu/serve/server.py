@@ -6,27 +6,36 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
   rows are per-sample input arrays (net input shape, e.g. H×W×C
   nested lists). Response ``{"indices": [[...]], "probs": [[...]]}``.
   Shape errors -> 400; queue backpressure -> 503 with Retry-After.
-- ``GET /healthz`` — liveness + model identity + bucket config.
+- ``GET /healthz`` — liveness + model identity + bucket config; the
+  ``status`` field degrades to ``"degraded"`` while requests are being
+  shed/cancelled (deadline pressure), so balancers can back off.
 - ``GET /metrics`` — the ServeMetrics snapshot, one JSON object.
 
 The server is a ``ThreadingHTTPServer``: handler threads block on the
 batcher future while the single batcher worker feeds the device, so
 concurrent requests coalesce into full buckets. ``Client`` wraps
 ``http.client`` for tests and the load generator — same wire path as
-external traffic, no test-only shortcuts.
+external traffic, no test-only shortcuts — and retries 503s and
+connection errors with capped exponential backoff + jitter, honoring
+``Retry-After``, so a flapping server (or the ``serve.conn_drop``
+chaos point) is survived instead of surfaced.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import random
+import socket
 import threading
+import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
-from .batcher import Backpressure, MicroBatcher
+from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .metrics import ServeMetrics
 
 
@@ -45,6 +54,8 @@ class InferenceServer:
     ):
         """``port=0`` binds an ephemeral port (tests); the bound port is
         ``self.port`` either way."""
+        from .. import chaos
+
         self.engine = engine
         self.metrics = (
             metrics
@@ -53,8 +64,15 @@ class InferenceServer:
         )
         if getattr(engine, "metrics", None) is None:
             engine.metrics = self.metrics
-        self.batcher = batcher or MicroBatcher(engine, metrics=self.metrics)
+        # default batcher: requests the handler would abandon at its
+        # timeout carry the same deadline, so the batcher sheds them
+        # before compute instead of computing into the void
+        self.batcher = batcher or MicroBatcher(
+            engine, metrics=self.metrics, deadline_s=request_timeout_s
+        )
         self.model_name = model_name
+        self._chaos = chaos.get_plan()
+        self._post_seq = itertools.count()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -77,12 +95,14 @@ class InferenceServer:
                     self._reply(
                         200,
                         {
-                            "status": "ok",
+                            "status": outer.metrics.health(),
                             "model": outer.model_name,
                             "buckets": list(
                                 getattr(outer.engine, "buckets", ())
                             ),
                             "output": getattr(outer.engine, "output", None),
+                            "shed": outer.metrics.shed,
+                            "cancelled": outer.metrics.cancelled,
                         },
                     )
                 elif self.path == "/metrics":
@@ -93,6 +113,18 @@ class InferenceServer:
             def do_POST(self):
                 if self.path != "/classify":
                     self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                if outer._chaos is not None and outer._chaos.fires(
+                    "serve.conn_drop", request=next(outer._post_seq)
+                ):
+                    # flaky-network chaos: drop the connection with no
+                    # response — the client's retry path sees a reset
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.connection.close()
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -119,8 +151,19 @@ class InferenceServer:
                     out = fut.result(timeout=outer.request_timeout_s)
                 except FuturesTimeout:
                     outer.metrics.record_error()
+                    # mark the in-flight request cancelled: if it's
+                    # still queued, the batcher drops it before compute
+                    # (and counts it) instead of computing a reply
+                    # nobody reads
                     fut.cancel()
                     self._reply(504, {"error": "inference timed out"})
+                    return
+                except DeadlineExceeded as e:
+                    # shed before compute: overload, not caller error —
+                    # 503 + Retry-After invites the client's backoff
+                    self._reply(
+                        503, {"error": str(e)}, headers=(("Retry-After", "1"),)
+                    )
                     return
                 except Exception as e:
                     # engine-side failure (bad shape surfaces here too:
@@ -177,14 +220,34 @@ class InferenceServer:
 
 
 class Client:
-    """Programmatic client over the same HTTP surface (tests, loadgen)."""
+    """Programmatic client over the same HTTP surface (tests, loadgen).
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    Transient failures — connection drops/resets and 503 (queue
+    backpressure or deadline shedding) — are retried up to ``retries``
+    times with capped exponential backoff plus jitter; a ``Retry-After``
+    header raises the wait (still capped by ``max_backoff_s``).
+    Anything else (2xx/4xx/5xx, or errors past the budget) is returned
+    or raised as-is, so callers never see a silent drop or an unbounded
+    hang: the socket ``timeout`` bounds every attempt."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 60.0,
+        *,
+        retries: int = 2,
+        backoff_s: float = 0.05,
+        max_backoff_s: float = 2.0,
+    ):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
 
-    def _request(self, method: str, path: str, payload=None):
+    def _once(self, method: str, path: str, payload=None):
         import http.client
 
         conn = http.client.HTTPConnection(
@@ -197,10 +260,45 @@ class Client:
             )
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
+            retry_after = resp.getheader("Retry-After")
             data = json.loads(resp.read() or b"{}")
-            return resp.status, data
+            return resp.status, data, retry_after
         finally:
             conn.close()
+
+    def _request(self, method: str, path: str, payload=None):
+        import http.client
+
+        for attempt in range(self.retries + 1):
+            retry_after = None
+            try:
+                status, data, retry_after = self._once(method, path, payload)
+            except (OSError, http.client.HTTPException):
+                # dropped/reset connection (or the serve.conn_drop
+                # chaos point); the socket timeout bounds the attempt
+                if attempt >= self.retries:
+                    raise
+            else:
+                if status != 503:
+                    if attempt:
+                        from .. import chaos
+
+                        chaos.record_recovery("serve.client_retry")
+                    return status, data
+                if attempt >= self.retries:
+                    return status, data
+            sleep = min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
+            if retry_after is not None:
+                try:
+                    sleep = min(
+                        max(sleep, float(retry_after)), self.max_backoff_s
+                    )
+                except ValueError:
+                    pass
+            # jitter in [0.5x, 1x]: desynchronizes a retry storm while
+            # staying inside the cap
+            time.sleep(sleep * random.uniform(0.5, 1.0))
+        raise AssertionError("unreachable")
 
     def healthz(self):
         return self._request("GET", "/healthz")

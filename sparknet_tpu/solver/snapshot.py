@@ -17,6 +17,17 @@ Two on-disk formats, selected by the path suffix:
   (``--snapshot-format orbax``): the TPU-ecosystem format, which
   writes sharded device arrays directly (no host gather) and scales to
   model sizes where a single npz is impractical.
+
+Durability (docs/ROBUSTNESS.md): npz writes are atomic — staged to a
+``.tmp``, fsynced, renamed, directory fsynced — and carry an array
+manifest (name/dtype/shape) that :func:`load_state` verifies, so a
+torn file (power cut before the data hit disk, a copy that stopped
+half-way, the ``snapshot.partial_write`` chaos point) raises
+:class:`SnapshotError` instead of resuming from garbage.
+:func:`restore_with_fallback` turns that into self-healing: auto-resume
+falls back to the next-newest snapshot under the prefix.
+:func:`prune_snapshots` keeps the last k (``SPARKNET_SNAPSHOT_KEEP``)
+so the fallback chain exists without unbounded disk growth.
 """
 
 from __future__ import annotations
@@ -25,9 +36,19 @@ import glob
 import json
 import os
 import re
-from typing import Any, Dict, Optional
+import sys
+import zipfile
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+
+class SnapshotError(RuntimeError):
+    """A solverstate file is torn or unreadable (truncated zip, missing
+    metadata, manifest mismatch).  Distinct from ValueError (version
+    mismatch — a *valid* file we must not silently reinterpret) so the
+    fallback path only swallows actual corruption."""
 
 # v2: the feed's augmentation rng became per-batch default_rng((seed,
 # epoch, bi)) — required for O(1) skip(n) resume — which changes the
@@ -157,14 +178,86 @@ def save_state(path: str, **trees: Any) -> None:
     }
     if not primary:
         return
-    meta = json.dumps({"version": FORMAT_VERSION, "structure": structure})
     arrays = {f"a{i}": leaf for i, leaf in enumerate(leaves)}
+    # the manifest lets restore verify every member decompressed intact
+    # (a truncated zip can still open and list names)
+    meta = json.dumps({
+        "version": FORMAT_VERSION,
+        "structure": structure,
+        "arrays": {
+            k: [a.dtype.str, list(a.shape)] for k, a in arrays.items()
+        },
+    })
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         np.savez(
             fh, **arrays, **{_META_KEY: np.frombuffer(meta.encode(), np.uint8)}
         )
+        fh.flush()
+        os.fsync(fh.fileno())
+    if _chaos_partial_write(tmp, path):
+        return
     os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Make the rename itself durable (an unfsynced directory entry can
+    vanish on power loss even though the data blocks survived)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+_save_seq = 0  # per-process save count, the chaos schedule index
+
+
+def _chaos_partial_write(tmp: str, path: str) -> bool:
+    """``snapshot.partial_write`` injection: publish a torn file at the
+    FINAL path — simulating a pre-atomic-write crash or a copy that
+    stopped half-way — so the restore-side verify + fallback is
+    exercisable.  Returns True when the fault fired."""
+    global _save_seq
+    seq, _save_seq = _save_seq, _save_seq + 1
+    from .. import chaos
+
+    plan = chaos.get_plan()
+    if plan is None:
+        return False
+    coords = {"index": seq}
+    m = re.search(r"_iter_(\d+)\.solverstate\.npz$", path)
+    if m:
+        coords["iter"] = int(m.group(1))
+    rule = plan.match("snapshot.partial_write", **coords)
+    if rule is None:
+        return False
+    frac = float(rule.params.get("frac", 0.5))
+    size = os.path.getsize(tmp)
+    with open(tmp, "rb+") as fh:
+        fh.truncate(max(1, int(size * frac)))
+    os.replace(tmp, path)
+    return True
+
+
+def ordered_solverstates(prefix: str) -> List[Tuple[int, str]]:
+    """Every ``{prefix}_iter_N.solverstate.{npz,orbax}`` on disk as
+    ``(iter, path)``, newest first — the fallback-restore chain and the
+    prune candidate list."""
+    out: List[Tuple[int, str]] = []
+    for suffix in (NPZ_SUFFIX, ORBAX_SUFFIX):
+        for path in glob.glob(f"{prefix}_iter_*{suffix}"):
+            m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path)
+            if m:
+                out.append((int(m.group(1)), path))
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
 
 
 def latest_solverstate(prefix: str) -> Optional[str]:
@@ -173,15 +266,76 @@ def latest_solverstate(prefix: str) -> Optional[str]:
     with the same snapshot_prefix picks up exactly where training
     stopped (the reference gets this from Spark task retry + Caffe
     snapshots; SURVEY.md §5 elasticity)."""
-    best: Optional[str] = None
-    best_iter = -1
-    for suffix in (NPZ_SUFFIX, ORBAX_SUFFIX):
-        for path in glob.glob(f"{prefix}_iter_*{suffix}"):
-            m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path)
-            if m and int(m.group(1)) > best_iter:
-                best_iter = int(m.group(1))
-                best = path
-    return best
+    states = ordered_solverstates(prefix)
+    return states[0][1] if states else None
+
+
+def prune_snapshots(prefix: str, keep: Optional[int] = None) -> List[str]:
+    """Keep the newest ``keep`` solverstates under ``prefix`` (default
+    ``SPARKNET_SNAPSHOT_KEEP``, 8; 0 keeps everything) and delete the
+    rest, along with each pruned iteration's ``_iter_N.npz`` weights
+    twin.  Returns the removed paths.  Keeping >1 is what gives the
+    torn-file fallback a snapshot to fall back TO."""
+    if keep is None:
+        keep = int(os.environ.get("SPARKNET_SNAPSHOT_KEEP", "8") or 0)
+    if keep <= 0:
+        return []
+    removed: List[str] = []
+    for it, path in ordered_solverstates(prefix)[keep:]:
+        try:
+            if os.path.isdir(path):  # orbax checkpoint directory
+                import shutil
+
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+            removed.append(path)
+        except OSError:
+            continue
+        weights = f"{prefix}_iter_{it}.npz"
+        if os.path.exists(weights):
+            try:
+                os.remove(weights)
+                removed.append(weights)
+            except OSError:
+                pass
+    return removed
+
+
+def restore_with_fallback(solver, prefix: str, path: str, feed=None) -> str:
+    """Restore ``solver`` from ``path``; if that snapshot is torn
+    (:class:`SnapshotError`), fall back through the older solverstates
+    under ``prefix`` newest-first.  Returns the path actually restored;
+    re-raises the last error when nothing under the prefix is
+    restorable.  Each successful fallback counts a
+    ``snapshot.fallback_restore`` recovery — healing is observable."""
+    m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path or "")
+    start_iter = int(m.group(1)) if m else None
+    candidates = [path]
+    if prefix:
+        for it, cand in ordered_solverstates(prefix):
+            if cand != path and (start_iter is None or it < start_iter):
+                candidates.append(cand)
+    last_err: Optional[SnapshotError] = None
+    for i, cand in enumerate(candidates):
+        try:
+            solver.restore(cand, feed)
+        except SnapshotError as e:
+            last_err = e
+            print(
+                f"WARNING: solverstate {cand} is torn/unreadable ({e}); "
+                f"falling back to the previous snapshot",
+                file=sys.stderr, flush=True,
+            )
+            continue
+        if i:
+            from .. import chaos
+
+            chaos.record_recovery("snapshot.fallback_restore")
+        return cand
+    if last_err is not None:
+        raise last_err
+    raise SnapshotError(f"no restorable solverstate for prefix {prefix!r}")
 
 
 def resolve_auto_resume(prefix: str, explicit: Optional[str]) -> Optional[str]:
@@ -254,25 +408,59 @@ def apply_auto_resume(args, prefix: str) -> None:
 
 
 def load_state(path: str) -> Dict[str, Any]:
-    """Inverse of :func:`save_state`; leaves come back as host numpy."""
+    """Inverse of :func:`save_state`; leaves come back as host numpy.
+
+    Verifies the file before handing state back: a torn/unreadable file
+    (or one whose arrays don't match the saved manifest) raises
+    :class:`SnapshotError`; a version mismatch stays a loud
+    ``ValueError`` — that's a *valid* snapshot whose RNG stream
+    semantics changed, and falling back would hide it."""
     if path.endswith(ORBAX_SUFFIX):
         import jax
 
         ocp = _require_orbax()
-        got = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        try:
+            got = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        except (OSError, KeyError) as e:
+            raise SnapshotError(
+                f"torn or unreadable solverstate {path}: {e}"
+            ) from e
         version = int(np.asarray(got.get("__solverstate_version__", -1)))
         if version != FORMAT_VERSION:
             raise ValueError(
                 f"solverstate version {version} != {FORMAT_VERSION}"
             )
         return jax.tree_util.tree_map(np.asarray, got["trees"])
-    with np.load(path) as z:
-        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
-        if meta["version"] != FORMAT_VERSION:
-            raise ValueError(
-                f"solverstate version {meta['version']} != {FORMAT_VERSION}"
-            )
-        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    try:
+        with np.load(path) as z:
+            files = set(z.files)
+            if _META_KEY not in files:
+                raise KeyError("no solverstate metadata entry")
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+            # reading every member runs the zip CRC over the payload —
+            # truncated/garbled members raise here, not at training time
+            arrays = {k: z[k] for k in files - {_META_KEY}}
+    except (zipfile.BadZipFile, zlib.error, OSError, EOFError, KeyError,
+            UnicodeDecodeError, json.JSONDecodeError, ValueError) as e:
+        raise SnapshotError(
+            f"torn or unreadable solverstate {path}: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    if meta["version"] != FORMAT_VERSION:
+        raise ValueError(
+            f"solverstate version {meta['version']} != {FORMAT_VERSION}"
+        )
+    manifest = meta.get("arrays")
+    if manifest is not None:
+        for name, (dt, shape) in manifest.items():
+            got_a = arrays.get(name)
+            if got_a is None or got_a.dtype.str != dt or list(
+                got_a.shape
+            ) != list(shape):
+                raise SnapshotError(
+                    f"solverstate {path}: array {name!r} missing or "
+                    f"mismatched vs manifest (want {dt} {shape})"
+                )
     return {
         name: _decode(spec, arrays)
         for name, spec in meta["structure"].items()

@@ -11,10 +11,14 @@ app name is historical), so every app flag passes through — including
 ``--data-workers=N`` / ``SPARKNET_DATA_WORKERS`` for the multiprocess
 input pipeline (docs/PIPELINE.md; the training run prints the
 pipeline's per-stage wait metrics on exit, the host-bound vs
-device-bound answer). ``time`` routes to tools/time_net; ``test``
-builds the TEST-phase net and reports averaged metrics.  Both
-``--flag=value`` and ``--flag value`` spellings are accepted, like the
-original binary.
+device-bound answer) and ``--chaos=SPEC`` / ``SPARKNET_CHAOS`` for
+deterministic fault injection (docs/ROBUSTNESS.md; e.g.
+``SPARKNET_CHAOS=pipeline.worker_crash@batch=37 caffe train ...``
+kills a pipeline worker mid-epoch and the run completes with
+bit-identical weights, printing the ``chaos:`` recovery counters on
+exit). ``time`` routes to tools/time_net; ``test`` builds the
+TEST-phase net and reports averaged metrics.  Both ``--flag=value``
+and ``--flag value`` spellings are accepted, like the original binary.
 """
 
 from __future__ import annotations

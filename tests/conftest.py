@@ -71,17 +71,27 @@ import pytest
 @pytest.fixture(autouse=True, scope="session")
 def assert_no_pipeline_leaks():
     """Tier-1 runs on CPU and must stay leak-free: after the whole
-    session, no input-pipeline worker process may still be alive and no
-    shared-memory slot may survive in /dev/shm (data/pipeline.py names
-    both with the SHM_PREFIX, so stray ones are attributable)."""
+    session, no input-pipeline worker process may still be alive — the
+    originals AND the chaos-era *respawned* replacements (named
+    ``{SHM_PREFIX}-worker-{r}-r{n}``; a supervisor that forgets its
+    respawns would pass a naive check) — and no shared-memory slot may
+    survive in /dev/shm, including the replacement slots respawns add
+    (``..._r{n}`` names).  data/pipeline.py names everything with the
+    SHM_PREFIX, so stray ones are attributable."""
     yield
+    import re
+
     from sparknet_tpu.data.pipeline import SHM_PREFIX
 
     stray = [
         p for p in multiprocessing.active_children()
         if p.name.startswith(SHM_PREFIX)
     ]
-    assert not stray, f"input-pipeline workers leaked past tests: {stray}"
+    respawned = [p for p in stray if re.search(r"-r\d+$", p.name)]
+    assert not stray, (
+        f"input-pipeline workers leaked past tests: {stray}"
+        + (f" (orphaned respawned workers: {respawned})" if respawned else "")
+    )
     if os.path.isdir("/dev/shm"):
         segs = glob.glob(f"/dev/shm/{SHM_PREFIX}_*")
         assert not segs, f"shared-memory segments leaked past tests: {segs}"

@@ -1,0 +1,528 @@
+"""chaos/ subsystem + the self-healing contracts it exercises.
+
+The acceptance bar (ISSUE 3): fault plans are deterministic and
+zero-cost when disabled; a killed pipeline worker is respawned and the
+run's batch stream (and trained weights) stay bit-identical with the
+recovery counter at exactly one; a torn snapshot falls back to the
+previous one; a retry storm against a flapping server ends with zero
+hung or silently-dropped requests; expired requests are shed before
+compute and surface as a degraded /healthz.  All CPU-only and fast —
+tier-1, no ``slow`` marker.
+"""
+
+import glob
+import multiprocessing
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import chaos
+from sparknet_tpu.chaos.plan import FAULT_POINTS, FaultPlan
+from sparknet_tpu.data.pipeline import SHM_PREFIX, ParallelBatchPipeline
+from sparknet_tpu.data.rdd import ShardedDataset
+from sparknet_tpu.serve.batcher import DeadlineExceeded, MicroBatcher
+from sparknet_tpu.serve.metrics import Counter, ServeMetrics
+from sparknet_tpu.serve.server import Client, InferenceServer
+from sparknet_tpu.solver import snapshot
+
+_HAVE_FORK = "fork" in multiprocessing.get_all_start_methods()
+fork_only = pytest.mark.skipif(
+    not _HAVE_FORK, reason="pipeline workers require the fork start method"
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    """No plan (or fire/recovery counts) may leak between tests."""
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _assert_no_pipeline_leaks():
+    stray = [
+        p for p in multiprocessing.active_children()
+        if p.name.startswith(SHM_PREFIX)
+    ]
+    assert not stray, f"leaked pipeline workers: {stray}"
+    if os.path.isdir("/dev/shm"):
+        segs = glob.glob(f"/dev/shm/{SHM_PREFIX}_*")
+        assert not segs, f"leaked shm segments: {segs}"
+
+
+# ------------------------------------------------------------- fault plans
+def test_spec_grammar_and_validation():
+    p = FaultPlan(
+        "pipeline.worker_crash@batch=37:worker=1,"
+        "serve.engine_stall@p=0.25:delay_ms=80,"
+        "snapshot.partial_write@index=1:frac=0.25",
+        seed=7,
+    )
+    assert p.points() == [
+        "pipeline.worker_crash", "serve.engine_stall",
+        "snapshot.partial_write",
+    ]
+    rule = p.match("pipeline.worker_crash", batch=37, worker=1)
+    assert rule is not None and rule.match == {"batch": 37, "worker": 1}
+    tear = p.match("snapshot.partial_write", index=1)
+    assert tear is not None and tear.params["frac"] == 0.25
+
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPlan("bogus.point")
+    with pytest.raises(ValueError, match="key=value"):
+        FaultPlan("serve.conn_drop@nonsense")
+    with pytest.raises(ValueError, match="must be a number"):
+        FaultPlan("serve.conn_drop@request=abc")
+    with pytest.raises(ValueError, match=r"p=2\.0"):
+        FaultPlan("serve.conn_drop@p=2.0")
+    with pytest.raises(ValueError, match="names no fault points"):
+        FaultPlan("  ,  ")
+    # every registered point parses bare
+    for point in FAULT_POINTS:
+        assert FaultPlan(point).points() == [point]
+
+
+def test_exact_coordinate_match_and_schedule_predicates():
+    p = FaultPlan("pipeline.worker_crash@batch=3:worker=1")
+    assert not p.fires("pipeline.worker_crash", batch=3, worker=0)
+    assert not p.fires("pipeline.worker_crash", batch=2, worker=1)
+    assert p.fires("pipeline.worker_crash", batch=3, worker=1)
+    assert not p.fires("pipeline.slow_batch", batch=3, worker=1)
+
+    every = FaultPlan("serve.conn_drop@every=3:after=3")
+    hits = [i for i in range(12) if every.fires("serve.conn_drop", request=i)]
+    assert hits == [3, 6, 9]
+
+    capped = FaultPlan("serve.conn_drop@times=2")
+    hits = [i for i in range(6) if capped.fires("serve.conn_drop", request=i)]
+    assert hits == [0, 1]  # budget spent after two fires
+
+
+def test_probabilistic_plans_are_seed_deterministic():
+    def decisions(seed):
+        p = FaultPlan("serve.engine_stall@p=0.4", seed=seed)
+        return [p.fires("serve.engine_stall", batch=i) for i in range(64)]
+
+    a, b = decisions(11), decisions(11)
+    assert a == b  # same seed + spec -> same fault sequence
+    assert any(a) and not all(a)
+    assert decisions(12) != a  # a different seed moves the faults
+
+
+def test_disabled_chaos_is_a_noop_fast_path(monkeypatch):
+    monkeypatch.delenv("SPARKNET_CHAOS", raising=False)
+    chaos.clear()
+    assert chaos.get_plan() is None and not chaos.active()
+    # hot-path call sites cache the plan once: disabled means the guard
+    # object is literally None (a single `is None` test per batch)
+    b = MicroBatcher(_EchoEngine(), max_latency_us=0)
+    assert b._chaos is None
+    b.drain()
+    srv = InferenceServer(_EchoEngine(), port=0).start()
+    assert srv._chaos is None
+    srv.stop()
+    assert chaos.METRICS.snapshot() == {"fires": {}, "recoveries": {}}
+
+
+def test_install_from_flag_wins_and_env_is_lazy(monkeypatch):
+    monkeypatch.setenv("SPARKNET_CHAOS", "serve.conn_drop@every=2")
+    chaos.clear()
+    env_plan = chaos.get_plan()
+    assert env_plan is not None and env_plan.points() == ["serve.conn_drop"]
+    flag_plan = chaos.install_from("serve.engine_stall@batch=0")
+    assert flag_plan.points() == ["serve.engine_stall"]
+    assert chaos.get_plan() is flag_plan  # explicit install wins over env
+
+
+# ---------------------------------------------------------------- pipeline
+def _ds(n=96, parts=4):
+    rng = np.random.default_rng(0)
+    return ShardedDataset.from_arrays(
+        {
+            "data": rng.normal(size=(n, 8, 8, 3)).astype(np.float32),
+            "label": np.arange(n, dtype=np.int32),
+        },
+        parts,
+    )
+
+
+def _aug(batch, r):
+    return {
+        "data": batch["data"]
+        + r.normal(size=batch["data"].shape).astype(np.float32),
+        "label": batch["label"],
+    }
+
+
+def _assert_same_stream(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert sorted(x) == sorted(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+
+
+@fork_only
+def test_worker_crash_respawns_and_stream_is_bit_identical():
+    ds = _ds()
+    serial = list(
+        ds.batches(8, shuffle=True, seed=3, epochs=2, transform=_aug)
+    )
+    chaos.install("pipeline.worker_crash@batch=6")
+    with ParallelBatchPipeline(
+        ds, 8, workers=2, shuffle=True, seed=3, epochs=2, transform=_aug
+    ) as pipe:
+        got = list(pipe)
+        respawns = pipe.metrics.worker_respawns
+        snap = pipe.metrics.snapshot()
+    _assert_same_stream(serial, got)
+    assert respawns == 1  # exactly one recovery, observable
+    assert snap["worker_respawns"] == 1
+    assert chaos.METRICS.recovery_count("pipeline.worker_respawn") == 1
+    _assert_no_pipeline_leaks()
+
+
+@fork_only
+def test_worker_crash_past_respawn_budget_fails_at_serial_position():
+    ds = _ds(n=48, parts=2)
+    chaos.install("pipeline.worker_crash@batch=4")
+    pipe = ParallelBatchPipeline(
+        ds, 8, workers=2, shuffle=False, seed=0, epochs=1, transform=_aug,
+        max_respawns=0,
+    )
+    with pytest.raises(RuntimeError, match="respawns already spent"):
+        list(pipe)
+    _assert_no_pipeline_leaks()
+
+
+@fork_only
+def test_slow_batch_fault_changes_timing_not_content():
+    ds = _ds(n=48, parts=2)
+    serial = list(
+        ds.batches(8, shuffle=False, seed=0, epochs=1, transform=_aug)
+    )
+    chaos.install("pipeline.slow_batch@every=2:delay_ms=30")
+    with ParallelBatchPipeline(
+        ds, 8, workers=2, shuffle=False, seed=0, epochs=1, transform=_aug
+    ) as pipe:
+        got = list(pipe)
+        respawns = pipe.metrics.worker_respawns
+    _assert_same_stream(serial, got)
+    assert respawns == 0  # slow is not dead
+    _assert_no_pipeline_leaks()
+
+
+# --------------------------------------------------------------- snapshots
+def test_npz_snapshot_carries_manifest_and_detects_torn_file(tmp_path):
+    import json
+
+    path = str(tmp_path / "st.solverstate.npz")
+    snapshot.save_state(
+        path, tree={"w": np.arange(12, dtype=np.float32)}, it=3
+    )
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__solverstate__"].tobytes()).decode())
+    assert "arrays" in meta and meta["arrays"]  # the verify manifest
+    assert snapshot.load_state(path)["it"] == 3
+    assert not glob.glob(str(tmp_path / "*.tmp"))  # staged write renamed
+
+    size = os.path.getsize(path)
+    with open(path, "rb+") as fh:
+        fh.truncate(size // 2)
+    with pytest.raises(snapshot.SnapshotError, match="torn or unreadable"):
+        snapshot.load_state(path)
+
+
+def test_partial_write_chaos_then_fallback_restore(tmp_path):
+    import jax
+
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    net_txt = """
+name: "tiny"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 3
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+    sp_txt = 'base_lr: 0.1\nlr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 8\n'
+
+    def make_solver():
+        sp = caffe_pb.load_solver(sp_txt, is_path=False)
+        sp.net_param = caffe_pb.load_net(net_txt, is_path=False)
+        return Solver(sp, {"data": (8, 6), "label": (8,)})
+
+    rng = np.random.default_rng(5)
+    batches = [
+        {
+            "data": rng.normal(size=(8, 6)).astype(np.float32),
+            "label": rng.integers(0, 3, 8).astype(np.int32),
+        }
+        for _ in range(4)
+    ]
+    prefix = str(tmp_path / "run")
+    chaos.install("snapshot.partial_write@iter=4")
+
+    s1 = make_solver()
+    s1.step(iter(batches[:2]), 2)
+    s1.save(f"{prefix}_iter_2.solverstate.npz")  # intact
+    s1.step(iter(batches[2:]), 2)
+    s1.save(f"{prefix}_iter_4.solverstate.npz")  # chaos tears this one
+    assert chaos.METRICS.snapshot()["fires"]["snapshot.partial_write"] == 1
+
+    torn = f"{prefix}_iter_4.solverstate.npz"
+    with pytest.raises(snapshot.SnapshotError):
+        snapshot.load_state(torn)
+
+    s2 = make_solver()
+    restored = snapshot.restore_with_fallback(s2, prefix, torn)
+    assert restored == f"{prefix}_iter_2.solverstate.npz"
+    assert s2.iter == 2
+    assert chaos.METRICS.recovery_count("snapshot.fallback_restore") == 1
+    # the fallback state is the real iter-2 state, not garbage
+    for layer, leaves in jax.device_get(s2.params).items():
+        for name, v in leaves.items():
+            np.testing.assert_array_equal(
+                v, np.asarray(snapshot.load_state(restored)["params"][layer][name])
+            )
+    # nothing under the prefix restorable -> the error surfaces
+    with open(f"{prefix}_iter_2.solverstate.npz", "rb+") as fh:
+        fh.truncate(10)
+    with pytest.raises(snapshot.SnapshotError):
+        snapshot.restore_with_fallback(make_solver(), prefix, torn)
+
+
+def test_prune_snapshots_keep_last_k(tmp_path):
+    prefix = str(tmp_path / "run")
+    for it in (2, 4, 6, 8, 10):
+        open(f"{prefix}_iter_{it}.solverstate.npz", "wb").close()
+        open(f"{prefix}_iter_{it}.npz", "wb").close()  # weights twin
+    removed = snapshot.prune_snapshots(prefix, keep=2)
+    left = sorted(os.path.basename(p) for p in glob.glob(f"{prefix}*"))
+    assert left == [
+        "run_iter_10.npz", "run_iter_10.solverstate.npz",
+        "run_iter_8.npz", "run_iter_8.solverstate.npz",
+    ]
+    assert len(removed) == 6
+    assert snapshot.prune_snapshots(prefix, keep=0) == []  # 0 disables
+
+
+# ----------------------------------------------------------------- serving
+class _EchoEngine:
+    """Duck-typed engine: identity infer + an argsort postprocess —
+    enough for the HTTP surface without compiling a net."""
+
+    buckets = (8,)
+    output = "prob"
+    metrics = None
+
+    def __init__(self):
+        self.calls = 0
+
+    def infer(self, rows):
+        self.calls += 1
+        return np.asarray(rows, np.float32)
+
+    def postprocess(self, out, top_k):
+        idx = np.argsort(-out, axis=-1)[:, :top_k]
+        probs = np.take_along_axis(out, idx, axis=-1)
+        return idx, probs
+
+
+class _BlockingEngine(_EchoEngine):
+    """Engine that blocks inside infer until released."""
+
+    def __init__(self):
+        super().__init__()
+        self.release = threading.Event()
+        self.started = threading.Event()
+
+    def infer(self, rows):
+        self.started.set()
+        assert self.release.wait(10)
+        return super().infer(rows)
+
+
+def test_client_retry_storm_against_flapping_server():
+    """Every other /classify connection is dropped cold; retrying
+    clients must end with every request answered — zero hung, zero
+    silently dropped — and the recoveries counted."""
+    chaos.install("serve.conn_drop@every=2")
+    eng = _EchoEngine()
+    srv = InferenceServer(
+        eng, port=0, model_name="echo",
+        batcher=MicroBatcher(eng, max_latency_us=0),
+    ).start()
+    try:
+        c = Client(
+            srv.host, srv.port, timeout=5,
+            retries=3, backoff_s=0.01, max_backoff_s=0.05,
+        )
+        rows = np.eye(5, dtype=np.float32)[:1]
+        n = 12
+        for _ in range(n):
+            st, resp = c.classify(rows, top_k=2)
+            assert st == 200
+            assert resp["indices"][0][0] == 0  # identity engine: argmax
+        snap = chaos.METRICS.snapshot()
+        assert snap["fires"]["serve.conn_drop"] == n
+        assert snap["recoveries"]["serve.client_retry"] == n
+        st, health = c.healthz()
+        assert st == 200 and health["status"] == "ok"  # flaky != degraded
+    finally:
+        srv.stop()
+
+
+def test_client_gives_up_after_retry_budget():
+    chaos.install("serve.conn_drop@every=1")  # always drop
+    eng = _EchoEngine()
+    srv = InferenceServer(
+        eng, port=0, batcher=MicroBatcher(eng, max_latency_us=0)
+    ).start()
+    try:
+        c = Client(
+            srv.host, srv.port, timeout=2,
+            retries=1, backoff_s=0.01, max_backoff_s=0.02,
+        )
+        with pytest.raises(OSError):
+            c.classify(np.zeros((1, 4), np.float32))
+    finally:
+        srv.stop()
+
+
+def test_engine_stall_sheds_expired_requests_before_compute():
+    """serve.engine_stall + a 50 ms deadline: the stalled flush must
+    shed the expired request without calling the engine, count it, and
+    degrade /healthz."""
+    chaos.install("serve.engine_stall@batch=0:delay_ms=120")
+    m = ServeMetrics()
+    eng = _EchoEngine()
+    b = MicroBatcher(
+        eng, max_batch=1, max_latency_us=0, deadline_s=0.05, metrics=m,
+    )
+    fut = b.submit(np.zeros((1, 4), np.float32))
+    with pytest.raises(DeadlineExceeded, match="expired"):
+        fut.result(timeout=10)
+    b.drain()
+    assert eng.calls == 0  # shed BEFORE compute
+    snap = m.snapshot()
+    assert snap["shed"] == 1 and snap["health"] == "degraded"
+    assert m.health() == "degraded"
+    assert chaos.METRICS.snapshot()["fires"]["serve.engine_stall"] == 1
+
+    # the degraded state is visible on the HTTP surface
+    srv = InferenceServer(
+        _EchoEngine(), metrics=m, port=0,
+        batcher=MicroBatcher(_EchoEngine(), max_latency_us=0),
+    ).start()
+    try:
+        st, health = srv.client().healthz()
+        assert st == 200
+        assert health["status"] == "degraded" and health["shed"] == 1
+    finally:
+        srv.stop()
+
+
+def test_server_timeout_cancels_inflight_request_and_batcher_drops_it():
+    """Two requests against a wedged engine: both handlers 504, and the
+    queued one must be dropped by the batcher (counted as cancelled)
+    instead of computed for nobody."""
+    m = ServeMetrics()
+    eng = _BlockingEngine()
+    srv = InferenceServer(
+        eng, metrics=m, port=0, request_timeout_s=0.4,
+        batcher=MicroBatcher(eng, max_batch=1, max_latency_us=0, metrics=m),
+    ).start()
+    try:
+        c = Client(srv.host, srv.port, timeout=10, retries=0)
+        results = []
+
+        def call():
+            results.append(c.classify(np.zeros((1, 4), np.float32)))
+
+        t1 = threading.Thread(target=call)
+        t1.start()
+        assert eng.started.wait(10)  # engine wedged on request 1
+        t2 = threading.Thread(target=call)
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        assert [st for st, _ in results] == [504, 504]
+        eng.release.set()  # unwedge; the queued request must be dropped
+        deadline = time.perf_counter() + 10
+        while m.cancelled < 1 and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert m.cancelled == 1
+        assert eng.calls == 1  # only the in-flight one ever computed
+        assert m.health() == "degraded"
+    finally:
+        srv.stop()
+
+
+def test_drain_raises_when_worker_is_wedged():
+    eng = _BlockingEngine()
+    b = MicroBatcher(eng, max_batch=1, max_latency_us=0)
+    b.submit(np.zeros((1, 3), np.float32))
+    assert eng.started.wait(10)
+    with pytest.raises(RuntimeError, match="did not stop"):
+        b.drain(timeout=0.2)
+    eng.release.set()  # let the worker finish so the thread exits
+    b._worker.join(10)
+
+
+# ------------------------------------------------------------ CLI e2e
+@fork_only
+def test_caffe_train_with_worker_crash_is_bit_identical(tmp_path, capsys):
+    """The acceptance run: ``caffe train`` with
+    SPARKNET_CHAOS-style injection of one pipeline worker crash
+    completes, final weights are bit-identical to the fault-free run,
+    and the recovery counter reads exactly one respawn."""
+    from sparknet_tpu.tools import caffe as caffe_cli
+
+    net_txt = """
+name: "tiny"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+
+    def run(tag, chaos_spec):
+        d = tmp_path / tag
+        d.mkdir()
+        (d / "net.prototxt").write_text(net_txt)
+        (d / "solver.prototxt").write_text(
+            'net: "net.prototxt"\nbase_lr: 0.05\nlr_policy: "fixed"\n'
+            'momentum: 0.9\nmax_iter: 6\nsnapshot: 6\n'
+            f'snapshot_prefix: "{d}/snap"\ndisplay: 0\n'
+        )
+        argv = [
+            "train", f"--solver={d}/solver.prototxt", "--synthetic",
+            "--synthetic-n=64", "--batch-size=8", "--seed=3",
+            "--data-workers=2", "--native-loader=off",
+        ]
+        if chaos_spec:
+            argv.append(f"--chaos={chaos_spec}")
+        caffe_cli.main(argv)
+        with np.load(f"{d}/snap_iter_6.npz") as z:
+            weights = {k: z[k].copy() for k in z.files}
+        return weights
+
+    chaotic = run("chaos", "pipeline.worker_crash@batch=3")
+    assert chaos.METRICS.recovery_count("pipeline.worker_respawn") == 1
+    out = capsys.readouterr().out
+    assert '"pipeline.worker_respawn": 1' in out  # the printed chaos line
+    chaos.clear()
+    clean = run("clean", None)
+    assert sorted(chaotic) == sorted(clean)
+    for k in clean:
+        np.testing.assert_array_equal(chaotic[k], clean[k], err_msg=k)
+    _assert_no_pipeline_leaks()
