@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Tier-1 regression gate (ISSUE 4 satellite): run the suite EXACTLY as
+# ROADMAP.md specifies, then compare the FAILED/ERROR set against the
+# committed baseline (tests/known_failures.txt — the pre-existing
+# jax.shard_map environment failures).  Exit nonzero only on NEW
+# failures, so "tier-1 no worse than seed" is machine-checkable:
+#
+#   ./scripts/check.sh            # full tier-1 + diff vs baseline
+#   CHECK_LOG=/tmp/my.log ./scripts/check.sh
+#
+# Also surfaces the conftest leak-fixture summary (stray input-pipeline
+# workers / /dev/shm segments after the session) — a leak shows up as a
+# session error and therefore as a NEW failure.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+LOG=${CHECK_LOG:-/tmp/_t1.log}
+KNOWN=tests/known_failures.txt
+rm -f "$LOG"
+
+# ROADMAP.md "Tier-1 verify", verbatim run parameters
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+
+# ---- leak-fixture summary (session-scoped assert in tests/conftest.py)
+if grep -aqE "workers leaked past tests|segments leaked past tests" "$LOG"; then
+  echo "check.sh: LEAK — the conftest leak fixture tripped:"
+  grep -aE "workers leaked past tests|segments leaked past tests" "$LOG"
+else
+  echo "check.sh: leak fixture clean (no stray pipeline workers or shm segments)"
+fi
+
+# ---- diff the failure set against the committed baseline
+failures=$(grep -aE '^(FAILED|ERROR) ' "$LOG" \
+  | sed -E 's/^(FAILED|ERROR) //; s/ - .*//' | sort -u)
+known=$(grep -vE '^[[:space:]]*(#|$)' "$KNOWN" | sort -u)
+
+new=$(comm -23 <(printf '%s\n' "$failures" | sed '/^$/d') \
+               <(printf '%s\n' "$known" | sed '/^$/d'))
+fixed=$(comm -13 <(printf '%s\n' "$failures" | sed '/^$/d') \
+                 <(printf '%s\n' "$known" | sed '/^$/d'))
+
+if [[ -n "$fixed" ]]; then
+  echo "check.sh: known failures now PASSING (prune them from $KNOWN):"
+  printf '  %s\n' $fixed
+fi
+
+if [[ -n "$new" ]]; then
+  echo "check.sh: NEW failures vs $KNOWN:"
+  printf '  %s\n' $new
+  exit 1
+fi
+
+if [[ $rc -ne 0 && -z "$failures" ]]; then
+  # pytest died without reporting failures (timeout, crash, collection
+  # wedge) — that is not a clean pass
+  echo "check.sh: pytest exited $rc with no parseable failure list — treating as failure"
+  exit "$rc"
+fi
+
+echo "check.sh: OK — no new failures ($(printf '%s\n' "$failures" | sed '/^$/d' | wc -l) known)"
+exit 0

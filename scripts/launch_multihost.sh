@@ -17,6 +17,16 @@
 #
 # Preemption recovery: append --auto-resume to the app args; every
 # relaunch resumes from the newest solverstate snapshot.
+#
+# Supervised mode (the Spark-driver equivalent, docs/MULTIHOST.md
+# "Recovery"): SPARKNET_SUPERVISE=1 (or --supervise in the app args)
+# wraps this host's process in the job supervisor — on failure it
+# relaunches automatically with --auto-resume under a restart budget,
+# capped backoff and flap detection, and leaves machine-readable
+# failure records in the run dir:
+#
+#   SPARKNET_SUPERVISE=1 ./scripts/launch_multihost.sh 4 0 -- \
+#       -m sparknet_tpu.apps.imagenet_app --arch alexnet --parallel local
 set -euo pipefail
 
 NUM=${1:?num_hosts}
@@ -33,4 +43,10 @@ export SPARKNET_COORDINATOR="$COORD"
 export SPARKNET_NUM_PROCESSES="$NUM"
 export SPARKNET_PROCESS_ID="$PID"
 
+if [[ "${SPARKNET_SUPERVISE:-0}" == "1" ]]; then
+  # per-host supervision: each host's supervisor owns its one local
+  # rank (SPARKNET_PROCESS_ID is set, so the app-side wiring spawns a
+  # single child and passes the rank through)
+  exec python "$@" --supervise
+fi
 exec python "$@"

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 import time
 from typing import Dict, Iterator
 
@@ -316,7 +317,13 @@ def record_loader_meta(solver, train_feed) -> None:
 def train_loop(
     solver: Solver, train_feed, test_feed, log=print, timer=None
 ) -> Dict[str, float]:
+    from .. import chaos
     from ..utils.profiling import StepTimer
+
+    # supervisor.child_crash injection site (checked once per loop
+    # chunk, i.e. at test/snapshot boundaries — not per iteration);
+    # disabled chaos is the usual cached-None single test
+    chaos_plan = chaos.get_plan()
 
     sp = solver.sp
     if not multihost.is_primary():
@@ -368,6 +375,27 @@ def train_loop(
             for k, v in last_test.items():
                 log(f"    Test net output: {k} = {v:.4f}")
         while solver.iter < sp.max_iter:
+            if chaos_plan is not None:
+                rule = chaos_plan.match(
+                    "supervisor.child_crash", iter=solver.iter
+                )
+                if rule is not None:
+                    # simulated hard host death at a boundary the
+                    # snapshot cadence may just have served: write the
+                    # machine-readable record (the child's crash path),
+                    # then die too hard for any cleanup — exactly what
+                    # the supervisor must recover from
+                    from ..supervise import records as _records
+
+                    _records.write_failure_record(
+                        process_id=multihost.process_index(),
+                        kind="chaos.child_crash",
+                        reason=(
+                            f"chaos supervisor.child_crash at iteration "
+                            f"{solver.iter}"
+                        ),
+                    )
+                    os._exit(int(rule.params.get("exit_code", 9)))
             # stop at the nearest of: next test boundary, next snapshot
             # boundary, max_iter — so neither cadence skips the other's.
             targets = [sp.max_iter]
@@ -468,8 +496,42 @@ def arg_parser() -> argparse.ArgumentParser:
                     help="deterministic fault injection, e.g. "
                          "'pipeline.worker_crash@batch=37:worker=1' "
                          "(also SPARKNET_CHAOS; docs/ROBUSTNESS.md)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run under the job supervisor: automatic "
+                         "relaunch with --auto-resume on failure, "
+                         "restart budget + backoff + flap detection "
+                         "(also SPARKNET_SUPERVISE=1; docs/MULTIHOST.md)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
+
+
+def maybe_supervise(module: str, argv, args, solver_path=None):
+    """``--supervise`` / ``SPARKNET_SUPERVISE=1`` wiring, shared by the
+    apps: re-exec this invocation as supervised child process(es)
+    (docs/MULTIHOST.md "Recovery") and return the supervisor's exit
+    code — or None when supervision is off, which costs exactly one
+    flag test on the way into the normal train path.  Children run
+    with ``SPARKNET_SUPERVISE=0``, so the branch can never recurse."""
+    if not (
+        getattr(args, "supervise", False)
+        or os.environ.get("SPARKNET_SUPERVISE", "") not in ("", "0")
+    ):
+        return None
+    from ..supervise.supervisor import supervise_app
+
+    prefix = None
+    solver_path = solver_path or getattr(args, "solver", None)
+    if solver_path:
+        # the supervisor verifies the snapshot chain between launches;
+        # a text parse of the solver prototxt names the prefix without
+        # paying any backend/model build in the supervising process
+        from ..solver.snapshot import resolve_prefix
+
+        prefix = resolve_prefix(
+            caffe_pb.load_solver(solver_path).snapshot_prefix or ""
+        ) or None
+    raw = list(sys.argv[1:] if argv is None else argv)
+    return supervise_app(module, raw, prefix)
 
 
 def main(argv=None):
@@ -479,6 +541,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser(parents=[arg_parser()],
                                  description="CIFAR-10 training (CifarApp)")
     args = ap.parse_args(argv)
+
+    code = maybe_supervise("sparknet_tpu.apps.cifar_app", argv, args)
+    if code is not None:
+        if code:
+            raise SystemExit(code)
+        return None
 
     from .. import chaos
 
@@ -492,6 +560,10 @@ def main(argv=None):
 
     solver.sp.snapshot_prefix = resolve_prefix(solver.sp.snapshot_prefix)
     apply_auto_resume(args, solver.sp.snapshot_prefix)
+    # elastic resume (supervisor degrade path): restore weights but
+    # re-init optimizer slots — the snapshot's slots may be laid out
+    # for a dp width this relaunch no longer has
+    weights_only = os.environ.get("SPARKNET_ELASTIC_RESUME", "") == "1"
     if args.restore:
         if args.auto_resume:
             # auto-resume owns the snapshot chain: a torn newest file
@@ -500,12 +572,13 @@ def main(argv=None):
 
             args.restore = restore_with_fallback(
                 solver, solver.sp.snapshot_prefix, args.restore,
-                feed=train_feed,
+                feed=train_feed, weights_only=weights_only,
             )
         else:
             # an explicitly-named --restore must fail loudly on a torn
             # file: silently restoring something else isn't recovery
-            solver.restore(args.restore, train_feed)
+            solver.restore(args.restore, train_feed,
+                           weights_only=weights_only)
     # wrap AFTER restore: align_feed fast-forwards skipped batches,
     # which must stay host-side (and skippable), not device transfers
     from ..data.prefetch import maybe_prefetch
@@ -525,6 +598,14 @@ def main(argv=None):
     try:
         with trace(args.profile_dir):
             result = train_loop(solver, train_feed, test_feed)
+    except BaseException as e:
+        # supervised runs leave a machine-readable failure record (who,
+        # why, last completed iteration) for the supervisor's
+        # attribution; a no-op when unsupervised
+        from ..supervise import records as _records
+
+        _records.write_crash_record(e)
+        raise
     finally:
         # a multiprocess train feed owns worker processes + shm slots;
         # stop them even when the loop raises (and report its per-stage

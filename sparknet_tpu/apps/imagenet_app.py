@@ -289,6 +289,11 @@ def parser() -> argparse.ArgumentParser:
                     help="deterministic fault injection, e.g. "
                          "'pipeline.worker_crash@batch=37:worker=1' "
                          "(also SPARKNET_CHAOS; docs/ROBUSTNESS.md)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run under the job supervisor: automatic "
+                         "relaunch with --auto-resume on failure, "
+                         "restart budget + backoff + flap detection "
+                         "(also SPARKNET_SUPERVISE=1; docs/MULTIHOST.md)")
     ap.add_argument("--seed", type=int, default=0)
     return ap
 
@@ -298,6 +303,17 @@ def main(argv=None):
 
     honor_platform_env()
     args = parser().parse_args(argv)
+    from .cifar_app import maybe_supervise
+
+    code = maybe_supervise(
+        "sparknet_tpu.apps.imagenet_app", argv, args,
+        solver_path=args.solver or os.path.join(ZOO, ARCH_SOLVERS[args.arch]),
+    )
+    if code is not None:
+        if code:
+            raise SystemExit(code)
+        return None
+
     from .. import chaos
 
     chaos.install_from(args.chaos)  # --chaos wins over SPARKNET_CHAOS
@@ -310,6 +326,8 @@ def main(argv=None):
 
     solver.sp.snapshot_prefix = resolve_prefix(solver.sp.snapshot_prefix)
     apply_auto_resume(args, solver.sp.snapshot_prefix)
+    # elastic resume (supervisor degrade path — see cifar_app.main)
+    weights_only = os.environ.get("SPARKNET_ELASTIC_RESUME", "") == "1"
     if args.restore:
         if args.auto_resume:
             # torn newest snapshot -> previous one (see cifar_app.main)
@@ -317,10 +335,11 @@ def main(argv=None):
 
             args.restore = restore_with_fallback(
                 solver, solver.sp.snapshot_prefix, args.restore,
-                feed=train_feed,
+                feed=train_feed, weights_only=weights_only,
             )
         else:
-            solver.restore(args.restore, train_feed)
+            solver.restore(args.restore, train_feed,
+                           weights_only=weights_only)
     # wrap AFTER restore (see cifar_app.main)
     from ..data.prefetch import maybe_prefetch
 
@@ -339,6 +358,13 @@ def main(argv=None):
     try:
         with trace(args.profile_dir):
             result = train_loop(solver, train_feed, test_feed)
+    except BaseException as e:
+        # supervised runs leave a machine-readable failure record for
+        # the supervisor's attribution (see cifar_app.main)
+        from ..supervise import records as _records
+
+        _records.write_crash_record(e)
+        raise
     finally:
         # stop a multiprocess feed's workers/shm and report its waits
         # (host-bound vs device-bound) — see cifar_app.main

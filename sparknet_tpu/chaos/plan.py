@@ -72,6 +72,17 @@ FAULT_POINTS: Dict[str, str] = {
         "heartbeat client goes silent (peer appears dead to the "
         "fabric); coords: worker (process id), tick (ping count)"
     ),
+    "supervisor.child_crash": (
+        "supervised training child hard-exits (os._exit, after writing "
+        "its failure record) at a train-loop boundary; coords: iter "
+        "(solver iteration at the boundary); params: exit_code "
+        "(default 9)"
+    ),
+    "supervisor.resume_torn": (
+        "supervisor tears the newest solverstate before a relaunch, "
+        "forcing the verified-resume fallback chain; coords: index "
+        "(restart count); params: frac (default 0.5)"
+    ),
 }
 
 # which coordinate serves as the schedule index, in priority order

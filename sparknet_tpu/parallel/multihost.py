@@ -124,11 +124,24 @@ class _Heartbeat:
     def _die(self, why: str) -> None:
         if self._stop.is_set() or self._disarmed:
             return
+        from ..supervise import records
+
+        it = records.last_completed_iteration()
+        progress = (
+            f" (last completed iteration: {it})" if it is not None else ""
+        )
         print(
-            f"[sparknet multihost] process {self.pid}: {why} — exiting "
-            f"{EXIT_PEER_FAILURE} so the launcher can restart the job "
-            f"(--auto-resume recovers from the newest snapshot)",
+            f"[sparknet multihost] process {self.pid}: {why}{progress} — "
+            f"exiting {EXIT_PEER_FAILURE} so the launcher can restart the "
+            f"job (--auto-resume recovers from the newest snapshot)",
             file=sys.stderr, flush=True,
+        )
+        # supervised runs get a machine-readable record (who died, why,
+        # progress) for attribution; a no-op otherwise. Never raises —
+        # this is a dying path.
+        records.write_failure_record(
+            process_id=self.pid, kind="peer_failure", reason=why,
+            exit_code=EXIT_PEER_FAILURE,
         )
         os._exit(EXIT_PEER_FAILURE)
 
@@ -162,6 +175,13 @@ class _Heartbeat:
                             return
                         with self._lock:
                             self._last_seen[peer] = time.monotonic()
+                            # rejoin grace: a worker relaunched by a
+                            # per-host supervisor re-enters the fabric on
+                            # its first ping even after its predecessor
+                            # said a graceful bye — otherwise the new
+                            # incarnation's death would go unmonitored
+                            if not self._ending:
+                                self._expected.add(peer)
                         # during close()'s linger, every ping is answered
                         # "end" so workers that were mid-reconnect when the
                         # broadcast went out still learn of the clean finish
@@ -174,11 +194,23 @@ class _Heartbeat:
             with self._lock:
                 self._conns.discard(conn)
 
+    def _join_grace(self) -> float:
+        """How long a worker gets to make first contact.  Default covers
+        jax.distributed.initialize stragglers; supervised relaunches can
+        widen it (SPARKNET_HEARTBEAT_JOIN_GRACE) when children re-enter
+        staggered — e.g. restoring a big snapshot before the first ping."""
+        raw = os.environ.get("SPARKNET_HEARTBEAT_JOIN_GRACE", "")
+        try:
+            v = float(raw) if raw else 0.0
+        except ValueError:
+            v = 0.0
+        return v if v > 0 else max(3 * self.timeout, 30.0)
+
     def _monitor_loop(self):
         # workers must check in once within the join grace (they connect
         # right after jax.distributed.initialize returns, which already
         # required every process to be alive)
-        grace_until = time.monotonic() + max(3 * self.timeout, 30.0)
+        grace_until = time.monotonic() + self._join_grace()
         while not self._stop.is_set():
             time.sleep(self.interval)
             now = time.monotonic()
@@ -269,9 +301,7 @@ class _Heartbeat:
                     except OSError:
                         pass
                     conn = None
-            limit = (
-                self.timeout if joined else max(3 * self.timeout, 30.0)
-            )
+            limit = self.timeout if joined else self._join_grace()
             if time.monotonic() - last_ok > limit:
                 self._die(
                     f"no heartbeat ack from process 0 for {limit:.0f}s "
@@ -282,7 +312,10 @@ class _Heartbeat:
         if conn is not None:
             try:
                 conn.sendall(struct.pack("!i", -1 - self.pid))
-                conn.recv(3)
+                # _recv_exactly, same as the end-ack path: the 3-byte
+                # bye ack can legally arrive fragmented, and a raw
+                # recv(3) short-read would be misread as server-closed
+                _recv_exactly(conn, 3)
             except OSError:
                 pass
             try:

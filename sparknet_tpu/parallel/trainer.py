@@ -150,6 +150,23 @@ class ParallelSolver(Solver):
             )
         return params, state, opt_state
 
+    def _reinit_opt_state(self):
+        """Elastic weights-only resume: a snapshot taken at a different
+        dp width carries incompatible slot layouts (local mode's
+        per-dp-slice leading axis) — rebuild fresh slots in THIS
+        solver's layout instead."""
+        from ..solver.caffe_solver import init_opt_state
+
+        if self.mode == "sync":
+            return replicate(init_opt_state(self.sp, self.params), self.mesh)
+        ndp = self.mesh.shape[self.dp_axis]
+        return jax.device_put(
+            init_local_opt_state(self.sp, self.params, ndp),
+            jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
+            ),
+        )
+
     def _round_fn(self, tau: int):
         if tau not in self._rounds:
             self._rounds[tau] = make_local_sgd_round(

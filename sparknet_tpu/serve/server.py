@@ -219,6 +219,31 @@ class InferenceServer:
         return Client(self.host, self.port, timeout=timeout)
 
 
+def _retry_after_seconds(value: str) -> Optional[float]:
+    """``Retry-After`` in either RFC 7231 form — delta-seconds or an
+    HTTP-date — as seconds from now; None when unparseable.  A past
+    date clamps to 0 (retry immediately), and callers cap the result
+    at their backoff ceiling, so a bogus header can delay a retry by
+    at most the cap, never crash the retry loop."""
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    from email.utils import parsedate_to_datetime
+
+    try:
+        dt = parsedate_to_datetime(value)
+    except (TypeError, ValueError, IndexError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:  # RFC 5322 parse of a legacy date: UTC
+        from datetime import timezone
+
+        dt = dt.replace(tzinfo=timezone.utc)
+    return max(0.0, dt.timestamp() - time.time())
+
+
 class Client:
     """Programmatic client over the same HTTP surface (tests, loadgen).
 
@@ -289,12 +314,12 @@ class Client:
                     return status, data
             sleep = min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
             if retry_after is not None:
-                try:
-                    sleep = min(
-                        max(sleep, float(retry_after)), self.max_backoff_s
-                    )
-                except ValueError:
-                    pass
+                # both RFC 7231 forms (delta-seconds and HTTP-date),
+                # clamped to the backoff cap; unparseable values are
+                # ignored rather than crashing the retry loop
+                ra = _retry_after_seconds(retry_after)
+                if ra is not None:
+                    sleep = min(max(sleep, ra), self.max_backoff_s)
             # jitter in [0.5x, 1x]: desynchronizes a retry storm while
             # staying inside the cap
             time.sleep(sleep * random.uniform(0.5, 1.0))

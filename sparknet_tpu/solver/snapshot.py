@@ -302,13 +302,17 @@ def prune_snapshots(prefix: str, keep: Optional[int] = None) -> List[str]:
     return removed
 
 
-def restore_with_fallback(solver, prefix: str, path: str, feed=None) -> str:
+def restore_with_fallback(
+    solver, prefix: str, path: str, feed=None, weights_only: bool = False
+) -> str:
     """Restore ``solver`` from ``path``; if that snapshot is torn
     (:class:`SnapshotError`), fall back through the older solverstates
     under ``prefix`` newest-first.  Returns the path actually restored;
     re-raises the last error when nothing under the prefix is
     restorable.  Each successful fallback counts a
-    ``snapshot.fallback_restore`` recovery — healing is observable."""
+    ``snapshot.fallback_restore`` recovery — healing is observable.
+    ``weights_only`` is the supervisor's elastic resume (see
+    :meth:`Solver.restore <sparknet_tpu.solver.trainer.Solver.restore>`)."""
     m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path or "")
     start_iter = int(m.group(1)) if m else None
     candidates = [path]
@@ -319,7 +323,7 @@ def restore_with_fallback(solver, prefix: str, path: str, feed=None) -> str:
     last_err: Optional[SnapshotError] = None
     for i, cand in enumerate(candidates):
         try:
-            solver.restore(cand, feed)
+            solver.restore(cand, feed, weights_only=weights_only)
         except SnapshotError as e:
             last_err = e
             print(

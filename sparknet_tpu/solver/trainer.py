@@ -244,6 +244,14 @@ class Solver:
         # cooperative stop for preemption handling: step() returns at
         # the next iteration boundary once set (see apps' train_loop)
         self.stop_requested = False
+        # supervision plumbing: register as the process's progress
+        # source (one weakref store — the step path is untouched) so a
+        # crash handler (multihost._die, the apps' crash-record path)
+        # can name the last completed iteration without parsing
+        # snapshots
+        from ..supervise import records
+
+        records.publish_progress(self)
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         kw = step_compile_kw()
@@ -378,9 +386,18 @@ class Solver:
             env=dict(self.env_meta),
         )
 
-    def restore(self, path: str, feed=None) -> None:
+    def restore(self, path: str, feed=None, weights_only: bool = False) -> None:
         """Load a ``.solverstate.npz``; with ``feed`` given, also align
-        the data stream (see :meth:`align_feed`)."""
+        the data stream (see :meth:`align_feed`).
+
+        ``weights_only`` (the supervisor's elastic resume,
+        ``SPARKNET_ELASTIC_RESUME=1``): restore params/net state/
+        iteration/PRNG but re-initialize the optimizer slots — the
+        snapshot's slots may be sharded for a dp width the degraded
+        relaunch no longer has.  τ-local SGD averaging permits the
+        width change by construction; losing optimizer history costs a
+        few iterations of momentum re-warmup (documented tradeoff,
+        docs/MULTIHOST.md)."""
         from . import snapshot
 
         st = snapshot.load_state(path)
@@ -398,9 +415,15 @@ class Solver:
         self.iter = int(st["it"])
         self.rng = jnp.asarray(st["rng"])
         self._loss_window.clear()  # a restarted Caffe starts empty
-        self.params, self.state, self.opt_state = self._place_restored(
-            st["params"], st["state"], st["opt_state"]
-        )
+        if weights_only:
+            self.params, self.state, _ = self._place_restored(
+                st["params"], st["state"], {}
+            )
+            self.opt_state = self._reinit_opt_state()
+        else:
+            self.params, self.state, self.opt_state = self._place_restored(
+                st["params"], st["state"], st["opt_state"]
+            )
         if feed is not None:
             self.align_feed(feed)
 
@@ -450,6 +473,12 @@ class Solver:
         overrides to re-apply mesh shardings."""
         to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
         return to_dev(params), to_dev(state), to_dev(opt_state)
+
+    def _reinit_opt_state(self):
+        """Fresh optimizer slots for the current params — the elastic
+        weights-only resume path; ParallelSolver overrides to rebuild
+        its mode's slot layout/sharding."""
+        return init_opt_state(self.sp, self.params)
 
     def _put_batch(self, batch, train: bool = True):
         """Placement hook for one iteration's host batch; the base

@@ -1,0 +1,60 @@
+"""supervise/ — Spark-driver-equivalent automatic relaunch.
+
+The recovery loop above the heartbeat fabric and the snapshot layer
+(docs/MULTIHOST.md "Recovery", docs/ROBUSTNESS.md):
+
+- :class:`~sparknet_tpu.supervise.supervisor.Supervisor` — spawns the
+  training job as child process(es), classifies every exit, verifies
+  the snapshot chain, and relaunches with ``--auto-resume`` under a
+  budgeted/backed-off/flap-aware policy; degrades elastically when one
+  rank keeps failing.  Reached via the apps' ``--supervise`` flag
+  (``SPARKNET_SUPERVISE=1``) or the ``sparknet-supervise`` console
+  entry point.
+- :mod:`~sparknet_tpu.supervise.records` — machine-readable failure
+  records every crash path writes into the run dir (who died, why,
+  last completed iteration); the supervisor's attribution evidence.
+- :mod:`~sparknet_tpu.supervise.policy` — restart budget, capped
+  exponential backoff, flap detection, elastic width bookkeeping.
+- :mod:`~sparknet_tpu.supervise.metrics` — the ``supervisor:`` JSON
+  line (built on the serve/chaos ``Counter`` registry).
+
+Import-light on purpose: the heavy pieces load lazily so the
+supervisor process (and failure-record writers inside dying children)
+never pay a JAX backend init.
+"""
+
+from __future__ import annotations
+
+from . import records
+from .policy import Config, ElasticState, RestartPolicy, classify_exit
+
+__all__ = [
+    "Config",
+    "ElasticState",
+    "METRICS",
+    "RestartPolicy",
+    "SuperviseMetrics",
+    "Supervisor",
+    "classify_exit",
+    "records",
+    "supervise_app",
+]
+
+# lazy: metrics rides on serve's Counter (whose package import pulls
+# jax) and the supervisor is only needed in the supervising process —
+# a dying child writing a failure record must not pay either
+_LAZY = {
+    "Supervisor": "supervisor",
+    "supervise_app": "supervisor",
+    "METRICS": "metrics",
+    "SuperviseMetrics": "metrics",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

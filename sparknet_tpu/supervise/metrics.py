@@ -1,0 +1,53 @@
+"""Supervisor observability: one ``supervisor:`` JSON line per run.
+
+Same discipline as the chaos and serving registries (and built on the
+same :class:`~sparknet_tpu.serve.metrics.Counter` primitive): every
+recovery-loop action — relaunches, elastic degrades and scale-ups,
+torn snapshots skipped by the pre-relaunch verify, records synthesized
+for children that died too hard to write their own — is counted
+process-globally and dumped as ONE JSON line when the supervisor
+finishes (cleanly or by giving up), so a log line carries the whole
+recovery story and tests can assert exact counts on it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict
+
+from ..serve.metrics import Counter
+
+
+class SuperviseMetrics:
+    """Named monotone counters for the supervisor's recovery loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+        c.inc(n)
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            c = self._counters.get(name)
+        return c.snapshot() if c is not None else 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: c.snapshot() for k, c in self._counters.items()}
+
+    def json_line(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+METRICS = SuperviseMetrics()

@@ -1,0 +1,171 @@
+"""Machine-readable failure records — the supervisor's evidence trail.
+
+Spark's driver knows *which* executor died and what it was doing when
+it rescheduled the lost work; our restart-level recovery needs the same
+attribution or the supervisor is relaunching blind.  Every crash path
+in a supervised child — ``multihost._die`` (peer failure), the apps'
+top-level exception handler, the ``supervisor.child_crash`` chaos site
+— writes one small JSON file into ``{run_dir}/failures/``: who died
+(process id), why (kind + reason), the exit code, and the last
+completed training iteration.  The supervisor reads the records of
+each failed generation to attribute the failure to a rank (the elastic
+degrade signal) and synthesizes a record for any child that died too
+hard to write its own (SIGKILL, OOM).
+
+Zero overhead off the supervised path: records are written only when
+``SPARKNET_SUPERVISE_DIR`` is set (the supervisor exports it into
+child environments); everywhere else every writer is a no-op.  The
+module is jax-free so the supervisor and dummy test children can
+import it without paying a backend init.
+
+Progress plumbing: :class:`~sparknet_tpu.solver.trainer.Solver`
+registers itself via :func:`publish_progress` at init (one weakref
+store, nothing on the step path), so a crash handler — including
+``multihost._die`` firing from a heartbeat thread — can name the last
+completed iteration without parsing snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+# exported into child envs by the supervisor; gates every writer
+RECORD_DIR_ENV = "SPARKNET_SUPERVISE_DIR"
+# the supervisor's relaunch counter, stamped into each record so a
+# generation's records are attributable without mtime heuristics
+GENERATION_ENV = "SPARKNET_SUPERVISE_GEN"
+
+RECORD_VERSION = 1
+
+_progress_ref: Optional[weakref.ref] = None
+
+
+def publish_progress(solver: Any) -> None:
+    """Register ``solver`` (anything with an ``iter`` attribute) as the
+    process's training-progress source.  Called once at Solver init —
+    the hot step path is untouched."""
+    global _progress_ref
+    _progress_ref = weakref.ref(solver)
+
+
+def last_completed_iteration() -> Optional[int]:
+    """The registered solver's iteration counter, or None when no
+    solver ever registered (or it was garbage-collected)."""
+    if _progress_ref is None:
+        return None
+    solver = _progress_ref()
+    if solver is None:
+        return None
+    try:
+        return int(solver.iter)
+    except (TypeError, ValueError, AttributeError):
+        return None
+
+
+def supervised_dir() -> Optional[str]:
+    """The active supervision run dir, or None when unsupervised."""
+    return os.environ.get(RECORD_DIR_ENV) or None
+
+
+def failures_dir(root: str) -> str:
+    return os.path.join(root, "failures")
+
+
+def write_failure_record(
+    *,
+    process_id: int,
+    kind: str,
+    reason: str,
+    exit_code: Optional[int] = None,
+    root: Optional[str] = None,
+    generation: Optional[int] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Optional[str]:
+    """Write one failure record; returns its path, or None when
+    supervision is inactive (no ``root`` and no env dir).  Must never
+    raise — every caller is already on a dying path."""
+    root = root or supervised_dir()
+    if not root:
+        return None
+    try:
+        if generation is None:
+            generation = int(os.environ.get(GENERATION_ENV, "-1") or -1)
+        record = {
+            "version": RECORD_VERSION,
+            "time": time.time(),
+            "process_id": int(process_id),
+            "pid": os.getpid(),
+            "generation": generation,
+            "kind": kind,
+            "reason": reason,
+            "exit_code": exit_code,
+            "last_completed_iteration": last_completed_iteration(),
+        }
+        if extra:
+            record.update(extra)
+        d = failures_dir(root)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d,
+            f"failure-g{generation}-p{process_id}-{os.getpid()}-"
+            f"{time.monotonic_ns()}.json",
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(record, fh, indent=1)
+        os.replace(tmp, path)  # readers never see a half-written record
+        return path
+    except Exception:
+        return None
+
+
+def write_crash_record(exc: BaseException) -> Optional[str]:
+    """The apps' top-level crash path: record an uncaught exception
+    before it unwinds the process.  Clean ``SystemExit(0)`` is not a
+    crash; everything else is."""
+    if isinstance(exc, SystemExit) and exc.code in (0, None):
+        return None
+    return write_failure_record(
+        process_id=_env_process_id(),
+        kind="exception",
+        reason=f"{type(exc).__name__}: {exc}",
+        exit_code=exc.code if isinstance(exc, SystemExit) else None,
+    )
+
+
+def _env_process_id() -> int:
+    try:
+        return int(os.environ.get("SPARKNET_PROCESS_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def read_failure_records(
+    root: str, generation: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """All readable records under ``root`` (optionally one generation's),
+    oldest first.  Unreadable files are skipped — a record is evidence,
+    never a crash source."""
+    d = failures_dir(root)
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name)) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if generation is not None and rec.get("generation") != generation:
+            continue
+        out.append(rec)
+    out.sort(key=lambda r: r.get("time", 0.0))
+    return out

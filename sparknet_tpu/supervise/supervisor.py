@@ -1,0 +1,523 @@
+"""Job supervisor — the Spark driver's restart responsibilities.
+
+SparkNet relied on the Spark driver to notice a dead executor and
+reschedule its work; TensorFlow-era jobs survive the same way under a
+supervisory layer doing checkpoint-and-restart.  Our SPMD deployment
+already has the detection half (the heartbeat fabric fail-fasts the
+whole job with ``EXIT_PEER_FAILURE``) and the durability half (atomic,
+manifest-verified snapshots with fallback restore) — this module owns
+the loop that closes recovery end to end:
+
+1. **spawn** the training job as child process(es): one per local
+   "host" when the supervisor owns a local multi-process cluster
+   (``SPARKNET_NUM_PROCESSES`` > 1 with no preset
+   ``SPARKNET_PROCESS_ID``), otherwise a single child;
+2. **classify** every exit — clean / ``EXIT_PEER_FAILURE`` / crash
+   signal / nonzero error — and collect the generation's
+   machine-readable failure records (synthesizing one for any child
+   that died too hard to write its own);
+3. **decide** via :class:`~sparknet_tpu.supervise.policy.RestartPolicy`
+   (per-incident budget, capped exponential backoff with jitter, flap
+   detection) whether to relaunch or give up with a final report;
+4. **verify** the snapshot chain before each relaunch (the same
+   manifest walk ``restore_with_fallback`` performs) so a torn newest
+   snapshot is known — and observable — before the child hits it, and
+   relaunch with ``--auto-resume``;
+5. **degrade elastically** when failures attribute to one rank
+   repeatedly: relaunch with one fewer process (τ-local SGD averaging
+   permits the narrower width; optimizer state re-initializes via
+   ``SPARKNET_ELASTIC_RESUME``), and scale back up after a healthy
+   degraded generation.
+
+Relaunched children run with chaos disarmed (``SPARKNET_CHAOS`` is
+cleared and ``--chaos`` stripped): a deterministic fault that already
+killed the job once would re-fire at the same coordinate forever and
+burn the restart budget on one injection — the same rule pipeline
+worker respawns follow.
+
+Everything here is plain ``subprocess`` + files: on a 1-CPU CI box the
+children are CPU JAX processes; on a pod each host runs its own
+supervisor around its one local rank (``scripts/launch_multihost.sh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import records
+from .metrics import METRICS
+from .policy import (
+    CLEAN,
+    Config,
+    ElasticState,
+    RestartPolicy,
+    classify_exit,
+)
+
+REPORT_NAME = "supervisor_report.json"
+
+
+def _log(msg: str) -> None:
+    print(f"[sparknet supervisor] {msg}", flush=True)
+
+
+def strip_flag(argv: Sequence[str], flag: str, has_value: bool = False) -> List[str]:
+    """Remove ``flag`` (and ``flag=x`` / its separate value) from argv."""
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == flag:
+            skip = has_value
+            continue
+        if a.startswith(flag + "="):
+            continue
+        out.append(a)
+    return out
+
+
+class Supervisor:
+    """Owns the relaunch loop for one training job.
+
+    ``argv`` is the full child command (``[sys.executable, "-m", ...]``).
+    ``num_procs`` > 1 makes the supervisor own a local cluster: each
+    child gets ``SPARKNET_PROCESS_ID=i`` / ``SPARKNET_NUM_PROCESSES``
+    (the coordinator address must already be in the environment); with
+    ``num_procs == 1`` the environment passes through untouched, which
+    is the per-host deployment shape.
+    """
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        *,
+        num_procs: int = 1,
+        run_dir: Optional[str] = None,
+        snapshot_prefix: Optional[str] = None,
+        config: Optional[Config] = None,
+        auto_resume: bool = True,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.argv = list(argv)
+        self.num_procs = max(1, int(num_procs))
+        self.snapshot_prefix = snapshot_prefix or None
+        self.run_dir = (
+            run_dir
+            or os.environ.get("SPARKNET_RUN_DIR")
+            or (os.path.dirname(self.snapshot_prefix)
+                if self.snapshot_prefix else "")
+            or "."
+        )
+        self.cfg = config or Config()
+        self.auto_resume = auto_resume
+        self._base_env = dict(os.environ if env is None else env)
+        self.report: Dict[str, Any] = {
+            "version": 1,
+            "argv": self.argv,
+            "num_procs": self.num_procs,
+            "run_dir": os.path.abspath(self.run_dir),
+            "snapshot_prefix": self.snapshot_prefix,
+            "generations": [],
+            "final_status": None,
+        }
+
+    # -- child lifecycle ------------------------------------------------
+
+    def _child_env(self, generation: int, width: int, rank: Optional[int]):
+        env = dict(self._base_env)
+        env[records.RECORD_DIR_ENV] = os.path.abspath(self.run_dir)
+        env[records.GENERATION_ENV] = str(generation)
+        env["SPARKNET_SUPERVISE"] = "0"  # children must not re-supervise
+        if generation > 0:
+            # relaunches run with chaos disarmed (see module docstring)
+            env["SPARKNET_CHAOS"] = ""
+        if rank is not None:
+            env["SPARKNET_NUM_PROCESSES"] = str(width)
+            env["SPARKNET_PROCESS_ID"] = str(rank)
+        return env
+
+    def _child_argv(self, generation: int) -> List[str]:
+        argv = list(self.argv)
+        if generation > 0:
+            argv = strip_flag(argv, "--chaos", has_value=True)
+            if self.auto_resume and "--auto-resume" not in argv:
+                argv.append("--auto-resume")
+        return argv
+
+    def _spawn(self, generation: int, width: int):
+        argv = self._child_argv(generation)
+        procs: List[Tuple[int, subprocess.Popen]] = []
+        own_cluster = self.num_procs > 1
+        for i in range(width if own_cluster else 1):
+            rank = i if own_cluster else records._env_process_id()
+            p = subprocess.Popen(
+                argv,
+                env=self._child_env(
+                    generation, width, i if own_cluster else None
+                ),
+            )
+            procs.append((rank, p))
+        return procs
+
+    def _wait(self, procs) -> List[Tuple[int, int]]:
+        """Wait for every child; once one fails, healthy peers get
+        ``kill_grace_s`` to exit on their own (the heartbeat fabric
+        normally fails them within its timeout) before terminate, then
+        kill.  Returns ``[(rank, returncode), ...]`` in spawn order."""
+        fail_deadline = None
+        term_sent = False
+        try:
+            while True:
+                alive = [p for _, p in procs if p.poll() is None]
+                if not alive:
+                    break
+                failed = any(
+                    p.returncode not in (0, None) for _, p in procs
+                )
+                now = time.monotonic()
+                if failed and fail_deadline is None:
+                    fail_deadline = now + self.cfg.kill_grace_s
+                if fail_deadline is not None and now > fail_deadline:
+                    for p in alive:
+                        (p.kill if term_sent else p.terminate)()
+                    if term_sent:
+                        for p in alive:
+                            p.wait(timeout=10.0)
+                        break
+                    term_sent = True
+                    fail_deadline = now + 5.0
+                time.sleep(0.05)
+        except BaseException:
+            for _, p in procs:
+                if p.poll() is None:
+                    p.kill()
+            raise
+        return [(rank, p.returncode) for rank, p in procs]
+
+    # -- snapshot verification ------------------------------------------
+
+    def _verify_resume(self, restart_index: int) -> Optional[Tuple[int, str]]:
+        """The pre-relaunch half of ``restore_with_fallback``'s manifest
+        walk: find the newest *intact* solverstate under the prefix so
+        the relaunch's resume point is known (and torn files are
+        counted) before any child pays a backend init.  Returns
+        ``(iter, path)`` or None (fresh start)."""
+        from ..solver.snapshot import (
+            SnapshotError,
+            load_state,
+            ordered_solverstates,
+        )
+
+        self._chaos_resume_torn(restart_index)
+        if not self.snapshot_prefix:
+            return None
+        candidates = ordered_solverstates(self.snapshot_prefix)
+        for it, path in candidates:
+            try:
+                load_state(path)
+            except SnapshotError as e:
+                METRICS.inc("torn_snapshots")
+                _log(f"snapshot {path} is torn ({e}); the relaunch will "
+                     f"fall back past it")
+                continue
+            except ValueError as e:
+                # version mismatch: valid file, wrong era — auto-resume
+                # would fail loudly on it too; report, don't mask
+                _log(f"snapshot {path} is unrestorable ({e})")
+                continue
+            METRICS.inc("verified_resumes")
+            _log(f"verified resume point: iteration {it} ({path})")
+            return it, path
+        if candidates:
+            _log(
+                "WARNING: no intact solverstate under "
+                f"{self.snapshot_prefix!r} — the relaunch starts fresh "
+                "or fails at restore"
+            )
+        return None
+
+    def _chaos_resume_torn(self, restart_index: int) -> None:
+        """``supervisor.resume_torn`` injection: truncate the newest
+        solverstate before the verify walk, simulating a snapshot that
+        tore between the crash and the relaunch."""
+        from .. import chaos
+
+        plan = chaos.get_plan()
+        if plan is None or not self.snapshot_prefix:
+            return
+        rule = plan.match("supervisor.resume_torn", index=restart_index)
+        if rule is None:
+            return
+        from ..solver.snapshot import ordered_solverstates
+
+        states = ordered_solverstates(self.snapshot_prefix)
+        if not states:
+            return
+        _, path = states[0]
+        frac = float(rule.params.get("frac", 0.5))
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb+") as fh:
+                fh.truncate(max(1, int(size * frac)))
+        except OSError:
+            pass
+
+    # -- record bookkeeping ---------------------------------------------
+
+    def _collect_records(self, generation: int, exits) -> List[dict]:
+        """This generation's failure records, synthesizing one per
+        failed child that left none (SIGKILL/OOM leave no time to
+        write)."""
+        recs = records.read_failure_records(self.run_dir, generation)
+        seen_ranks = {r.get("process_id") for r in recs}
+        snapshot_iter = None
+        if self.snapshot_prefix:
+            from ..solver.snapshot import ordered_solverstates
+
+            states = ordered_solverstates(self.snapshot_prefix)
+            snapshot_iter = states[0][0] if states else None
+        for rank, rc in exits:
+            cls = classify_exit(rc)
+            if cls == CLEAN or rank in seen_ranks:
+                continue
+            reason = (
+                f"killed by signal {-rc}" if cls == "signal"
+                else f"exited with status {rc}"
+            )
+            records.write_failure_record(
+                process_id=rank,
+                kind=f"synthesized.{cls}",
+                reason=reason,
+                exit_code=rc,
+                root=self.run_dir,
+                generation=generation,
+                extra={"snapshot_iter": snapshot_iter},
+            )
+            METRICS.inc("records_synthesized")
+        return records.read_failure_records(self.run_dir, generation)
+
+    @staticmethod
+    def _attribute(recs: List[dict], exits) -> Optional[int]:
+        """The rank a failed generation is blamed on: the earliest
+        failure record's process id (records are evidence of who went
+        first), else the first child observed failing."""
+        for r in recs:
+            pid = r.get("process_id")
+            if pid is not None:
+                return int(pid)
+        for rank, rc in exits:
+            if classify_exit(rc) != CLEAN:
+                return rank
+        return None
+
+    def _write_report(self) -> str:
+        path = os.path.join(self.run_dir, REPORT_NAME)
+        os.makedirs(self.run_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.report, fh, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    def _finish(self, status: str, code: int) -> int:
+        self.report["final_status"] = status
+        self.report["exit_code"] = code
+        self.report["metrics"] = METRICS.snapshot()
+        path = self._write_report()
+        print(f"supervisor: {METRICS.json_line()}", flush=True)
+        _log(f"{status} (exit {code}); report: {path}")
+        return code
+
+    # -- the loop -------------------------------------------------------
+
+    def run(self) -> int:
+        policy = RestartPolicy(self.cfg)
+        elastic = ElasticState(self.cfg, self.num_procs)
+        width = self.num_procs
+        generation = 0
+        restarts = 0
+        action: Optional[str] = None
+        while True:
+            t0 = time.monotonic()
+            _log(
+                f"generation {generation}: launching "
+                f"{width if self.num_procs > 1 else 1} process(es)"
+                + (f" [{action}]" if action else "")
+            )
+            procs = self._spawn(generation, width)
+            exits = self._wait(procs)
+            duration = time.monotonic() - t0
+            classes = {rank: classify_exit(rc) for rank, rc in exits}
+            entry: Dict[str, Any] = {
+                "generation": generation,
+                "width": width,
+                "action": action,
+                "duration_s": round(duration, 3),
+                "exits": [
+                    {"rank": rank, "returncode": rc, "class": classes[rank]}
+                    for rank, rc in exits
+                ],
+            }
+            self.report["generations"].append(entry)
+            if all(c == CLEAN for c in classes.values()):
+                entry["records"] = []
+                return self._finish("done", 0)
+
+            recs = self._collect_records(generation, exits)
+            blamed = self._attribute(recs, exits)
+            entry["records"] = recs
+            entry["blamed_rank"] = blamed
+            was_healthy = duration >= self.cfg.healthy_s
+            if was_healthy:
+                policy.note_healthy_run()
+            policy.note_failure(time.monotonic())
+            last_it = max(
+                (
+                    r["last_completed_iteration"]
+                    for r in recs
+                    if r.get("last_completed_iteration") is not None
+                ),
+                default=None,
+            )
+            _log(
+                f"generation {generation} failed after {duration:.1f}s: "
+                + ", ".join(
+                    f"rank {rank}={classes[rank]}({rc})"
+                    for rank, rc in exits
+                    if classes[rank] != CLEAN
+                )
+                + (f"; last completed iteration {last_it}"
+                   if last_it is not None else "")
+            )
+            verdict, backoff, why = policy.decide()
+            if verdict == "give_up":
+                entry["give_up"] = why
+                METRICS.inc("give_ups")
+                _log(f"giving up: {why}")
+                code = next(
+                    (
+                        (128 - rc) if rc < 0 else rc
+                        for _, rc in exits
+                        if rc not in (0, None)
+                    ),
+                    1,
+                )
+                return self._finish("gave_up", code)
+
+            resume = self._verify_resume(restarts)
+            entry["resume"] = (
+                {"iter": resume[0], "path": resume[1]} if resume else None
+            )
+            width, action = elastic.next_width(width, blamed, was_healthy)
+            if action == "degrade":
+                METRICS.inc("degraded_relaunches")
+                _log(
+                    f"degrading: failures attribute to rank {blamed} "
+                    f"{elastic.consecutive_blame}x; relaunching with "
+                    f"{width} process(es) (optimizer state re-initializes)"
+                )
+            elif action == "scale_up":
+                METRICS.inc("scale_ups")
+                _log(f"scaling back up to {width} process(es)")
+            if self.num_procs > 1:
+                self._base_env["SPARKNET_ELASTIC_RESUME"] = (
+                    "1" if width != self.num_procs else "0"
+                )
+            METRICS.inc("restarts")
+            restarts += 1
+            from .. import chaos
+
+            chaos.record_recovery("supervisor.relaunch")
+            _log(f"relaunching in {backoff:.2f}s (restart {restarts})")
+            time.sleep(backoff)
+            generation += 1
+
+
+def supervise_app(
+    module: str, raw_argv: Sequence[str], snapshot_prefix: Optional[str]
+) -> int:
+    """The apps' ``--supervise`` wiring: re-exec this app as supervised
+    child process(es).  ``raw_argv`` is the app's own argv (the
+    ``--supervise`` flag is stripped; everything else passes through).
+    """
+    argv = strip_flag(list(raw_argv), "--supervise")
+    cmd = [sys.executable, "-m", module] + argv
+    if os.environ.get("SPARKNET_PROCESS_ID"):
+        num_procs = 1  # per-host shape: the launcher owns rank identity
+    else:
+        try:
+            num_procs = int(os.environ.get("SPARKNET_NUM_PROCESSES", "1") or 1)
+        except ValueError:
+            num_procs = 1
+    return Supervisor(
+        cmd, num_procs=num_procs, snapshot_prefix=snapshot_prefix
+    ).run()
+
+
+def main(argv=None) -> int:
+    """``sparknet-supervise`` console entry point::
+
+        sparknet-supervise [--nprocs N] [--run-dir D] \\
+            [--snapshot-prefix P] [--restarts N] -- <command...>
+
+    Supervises an arbitrary command with the same policy the apps'
+    ``--supervise`` flag applies (docs/MULTIHOST.md "Recovery").
+    """
+    ap = argparse.ArgumentParser(
+        prog="sparknet-supervise",
+        description="relaunch a training job under the restart policy",
+    )
+    ap.add_argument("--nprocs", type=int, default=0,
+                    help="local cluster width (0: from "
+                         "SPARKNET_NUM_PROCESSES, or a single child)")
+    ap.add_argument("--run-dir", default=None,
+                    help="where failure records + the report land "
+                         "(default: SPARKNET_RUN_DIR, else the snapshot "
+                         "prefix's directory, else .)")
+    ap.add_argument("--snapshot-prefix", default=None,
+                    help="solver snapshot_prefix, for pre-relaunch "
+                         "snapshot verification")
+    ap.add_argument("--restarts", type=int, default=None,
+                    help="override SPARKNET_SUPERVISE_RESTARTS")
+    ap.add_argument("--no-auto-resume", action="store_true",
+                    help="do not append --auto-resume on relaunches")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the child command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (append: -- <command...>)")
+    nprocs = args.nprocs
+    if nprocs <= 0:
+        try:
+            nprocs = int(os.environ.get("SPARKNET_NUM_PROCESSES", "1") or 1)
+        except ValueError:
+            nprocs = 1
+        if os.environ.get("SPARKNET_PROCESS_ID"):
+            nprocs = 1
+    code = Supervisor(
+        cmd,
+        num_procs=nprocs,
+        run_dir=args.run_dir,
+        snapshot_prefix=args.snapshot_prefix,
+        config=Config(max_restarts=args.restarts)
+        if args.restarts is not None else None,
+        auto_resume=not args.no_auto_resume,
+    ).run()
+    return code
+
+
+if __name__ == "__main__":
+    signal.signal(signal.SIGINT, signal.default_int_handler)
+    raise SystemExit(main())

@@ -16,7 +16,13 @@ deterministic fault injection (docs/ROBUSTNESS.md; e.g.
 ``SPARKNET_CHAOS=pipeline.worker_crash@batch=37 caffe train ...``
 kills a pipeline worker mid-epoch and the run completes with
 bit-identical weights, printing the ``chaos:`` recovery counters on
-exit). ``time`` routes to tools/time_net; ``test`` builds the
+exit) and ``--supervise`` / ``SPARKNET_SUPERVISE=1`` for the job
+supervisor (docs/MULTIHOST.md "Recovery": the training run becomes
+child process(es) that are automatically relaunched with
+``--auto-resume`` under a restart budget, capped backoff and flap
+detection, with machine-readable failure records in the run dir and a
+``supervisor:`` recovery-counter line on exit).
+``time`` routes to tools/time_net; ``test`` builds the
 TEST-phase net and reports averaged metrics.  Both ``--flag=value``
 and ``--flag value`` spellings are accepted, like the original binary.
 """

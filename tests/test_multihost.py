@@ -69,6 +69,78 @@ def test_two_processes_match_single_process(tmp_path):
             )
 
 
+def test_end_broadcast_reaches_reconnecting_worker():
+    """The end-broadcast race (ISSUE 4 satellite): a worker that was
+    mid-reconnect when process 0's close() broadcast went out must
+    still receive ``end`` from the lingering server — not misread the
+    situation as a dead peer.  Drives :class:`_Heartbeat` directly (no
+    jax.distributed, no cluster)."""
+    import struct
+    import threading
+    import time
+
+    from sparknet_tpu.parallel.multihost import _Heartbeat, _recv_exactly
+
+    port = _free_port()
+    hb = _Heartbeat("127.0.0.1", port, 0, 2, interval=1.0, timeout=10.0)
+    try:
+        # worker 1 joins, pings once, then drops its connection — the
+        # "mid-reconnect when the broadcast went out" state
+        c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c.sendall(struct.pack("!i", 1))
+        assert _recv_exactly(c, 3) == b"ok\n"
+        c.close()
+        closer = threading.Thread(target=hb.close)
+        closer.start()
+        deadline = time.monotonic() + 5
+        while not hb._ending and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # reconnect during the linger: the ack slot must carry "end"
+        c2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c2.sendall(struct.pack("!i", 1))
+        assert _recv_exactly(c2, 3) == b"end"
+        # the graceful bye releases the linger early
+        c2.sendall(struct.pack("!i", -2))
+        _recv_exactly(c2, 3)
+        c2.close()
+        closer.join(10)
+        assert not closer.is_alive()
+    finally:
+        hb._stop.set()
+
+
+def test_worker_rejoins_fabric_after_graceful_bye():
+    """Rejoin grace (ISSUE 4): after a worker's graceful bye, a new
+    incarnation (per-host supervisor relaunch) pinging with the same id
+    re-enters the monitored set instead of running unwatched."""
+    import struct
+
+    from sparknet_tpu.parallel.multihost import _Heartbeat, _recv_exactly
+
+    port = _free_port()
+    hb = _Heartbeat("127.0.0.1", port, 0, 2, interval=0.2, timeout=5.0)
+    try:
+        c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c.sendall(struct.pack("!i", 1))
+        assert _recv_exactly(c, 3) == b"ok\n"
+        c.sendall(struct.pack("!i", -2))  # graceful bye
+        _recv_exactly(c, 3)
+        c.close()
+        with hb._lock:
+            assert 1 not in hb._expected
+        c2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c2.sendall(struct.pack("!i", 1))
+        assert _recv_exactly(c2, 3) == b"ok\n"
+        with hb._lock:
+            assert 1 in hb._expected  # monitored again
+        c2.sendall(struct.pack("!i", -2))
+        _recv_exactly(c2, 3)
+        c2.close()
+        hb.close()
+    finally:
+        hb._stop.set()
+
+
 @pytest.mark.slow
 def test_dead_peer_fails_the_job_fast(tmp_path):
     """Live failure detection (SURVEY.md §5): worker 1 dies hard

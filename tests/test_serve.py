@@ -270,6 +270,54 @@ def test_server_healthz_metrics_classify_roundtrip():
         srv.stop()
 
 
+def test_retry_after_accepts_both_rfc7231_forms():
+    """ISSUE 4 satellite: Retry-After may be delta-seconds OR an
+    HTTP-date; both parse, a past date clamps to 0, and garbage is
+    ignored instead of crashing the retry loop."""
+    from email.utils import formatdate
+
+    from sparknet_tpu.serve.server import _retry_after_seconds
+
+    assert _retry_after_seconds("2") == 2.0
+    assert _retry_after_seconds("0") == 0.0
+    assert _retry_after_seconds("-3") == 0.0  # bogus negative clamps
+    # HTTP-date 3 seconds out -> roughly that many seconds
+    future = _retry_after_seconds(formatdate(time.time() + 3, usegmt=True))
+    assert future is not None and 1.0 <= future <= 4.0
+    # a date already past means "retry now", not a crash
+    past = _retry_after_seconds(formatdate(time.time() - 60, usegmt=True))
+    assert past == 0.0
+    assert _retry_after_seconds("soonish") is None
+    assert _retry_after_seconds("") is None
+
+
+def test_client_honors_http_date_retry_after_within_cap(monkeypatch):
+    """A 503 carrying an HTTP-date Retry-After far in the future must
+    delay the retry by at most max_backoff_s — and still retry."""
+    from email.utils import formatdate
+
+    from sparknet_tpu.serve.server import Client
+
+    c = Client("h", 1, retries=2, backoff_s=0.01, max_backoff_s=0.05)
+    calls = []
+
+    def fake_once(method, path, payload=None):
+        calls.append(method)
+        if len(calls) == 1:
+            return 503, {"error": "busy"}, formatdate(
+                time.time() + 3600, usegmt=True
+            )
+        return 200, {"ok": True}, None
+
+    monkeypatch.setattr(c, "_once", fake_once)
+    t0 = time.perf_counter()
+    status, data = c._request("GET", "/healthz")
+    elapsed = time.perf_counter() - t0
+    assert status == 200 and data == {"ok": True}
+    assert len(calls) == 2
+    assert elapsed < 1.0  # the 1-hour date was clamped to the cap
+
+
 # ------------------------------------------------- classify-tool parity
 def test_engine_matches_classify_tool_on_zoo_net():
     """classify (one-shot tool) and a bucketed serving engine over the

@@ -1,0 +1,410 @@
+"""supervise/ — the Spark-driver-equivalent relaunch loop (ISSUE 4).
+
+The acceptance bar: a chaos-killed training child is relaunched with
+``--auto-resume`` and the final weights are bit-identical to an
+uninterrupted run; a permanently flapping child exhausts the restart
+budget, exits nonzero, and leaves a complete machine-readable failure
+report; elastic degrade drops a repeatedly-blamed rank and scales back
+up after a healthy degraded generation; ``--supervise`` off adds
+nothing to the train path.  All CPU-only, plain subprocesses, no
+``jax.shard_map`` anywhere.
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import chaos
+from sparknet_tpu.supervise import records
+from sparknet_tpu.supervise.metrics import METRICS
+from sparknet_tpu.supervise.policy import (
+    Config,
+    ElasticState,
+    RestartPolicy,
+    classify_exit,
+)
+from sparknet_tpu.supervise.supervisor import (
+    REPORT_NAME,
+    Supervisor,
+    strip_flag,
+)
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_supervise_child.py")
+
+NET_TXT = """
+name: "tiny"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    """No chaos plan, supervisor metrics, or supervision env may leak
+    between tests (or in from the outer environment)."""
+    for var in (
+        "SPARKNET_SUPERVISE", "SPARKNET_SUPERVISE_DIR",
+        "SPARKNET_SUPERVISE_GEN", "SPARKNET_ELASTIC_RESUME",
+        "SPARKNET_RUN_DIR",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    chaos.clear()
+    METRICS.reset()
+    yield
+    chaos.clear()
+    METRICS.reset()
+
+
+def _cfg(**kw):
+    base = dict(
+        max_restarts=3, backoff_s=0.01, max_backoff_s=0.02,
+        flap_limit=20, flap_window_s=300.0, degrade_after=2,
+        healthy_s=0.5, kill_grace_s=5.0,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+# ---------------------------------------------------------------- records
+def test_failure_records_are_gated_and_round_trip(tmp_path, monkeypatch):
+    # unsupervised: every writer is a no-op
+    assert records.write_failure_record(
+        process_id=0, kind="x", reason="y"
+    ) is None
+    monkeypatch.setenv(records.RECORD_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(records.GENERATION_ENV, "2")
+    path = records.write_failure_record(
+        process_id=1, kind="test", reason="because", exit_code=5
+    )
+    assert path and os.path.exists(path)
+    (rec,) = records.read_failure_records(str(tmp_path))
+    assert rec["process_id"] == 1 and rec["generation"] == 2
+    assert rec["kind"] == "test" and rec["exit_code"] == 5
+    # generation filter
+    assert records.read_failure_records(str(tmp_path), generation=3) == []
+    # crash records skip clean SystemExit but keep real errors
+    assert records.write_crash_record(SystemExit(0)) is None
+    assert records.write_crash_record(RuntimeError("boom")) is not None
+
+
+def test_progress_plumbing_names_last_completed_iteration():
+    class FakeSolver:
+        iter = 17
+
+    s = FakeSolver()
+    records.publish_progress(s)
+    assert records.last_completed_iteration() == 17
+    del s  # weakref: a dead solver is not progress
+    assert records.last_completed_iteration() is None
+
+
+# ----------------------------------------------------------------- policy
+def test_classify_exit_taxonomy():
+    assert classify_exit(0) == "clean"
+    assert classify_exit(43) == "peer_failure"  # multihost.EXIT_PEER_FAILURE
+    assert classify_exit(-signal.SIGKILL) == "signal"
+    assert classify_exit(3) == "error"
+
+
+def test_restart_policy_budget_backoff_and_flap():
+    p = RestartPolicy(_cfg(max_restarts=2, backoff_s=1.0, max_backoff_s=3.0))
+    p.note_failure(0.0)
+    verdict, sleep1, _ = p.decide()
+    assert verdict == "restart" and 0.5 <= sleep1 <= 1.0
+    p.note_failure(1.0)
+    verdict, sleep2, _ = p.decide()
+    assert verdict == "restart" and 1.0 <= sleep2 <= 2.0
+    p.note_failure(2.0)
+    verdict, _, why = p.decide()
+    assert verdict == "give_up" and "budget" in why
+    # a healthy run resets the budget (per-incident semantics): the
+    # next incident restarts again, from the base backoff rung
+    p.note_healthy_run()
+    p.note_failure(3.0)
+    verdict, sleep4, _ = p.decide()
+    assert verdict == "restart" and 0.5 <= sleep4 <= 1.0
+    flappy = RestartPolicy(_cfg(max_restarts=100, flap_limit=3))
+    for t in (0.0, 1.0):
+        flappy.note_failure(t)
+        assert flappy.decide()[0] == "restart"
+    flappy.note_failure(2.0)
+    verdict, _, why = flappy.decide()
+    assert verdict == "give_up" and "flapping" in why
+
+
+def test_elastic_state_degrades_and_scales_up():
+    e = ElasticState(_cfg(degrade_after=2), full_width=3)
+    assert e.next_width(3, blamed=1, was_healthy=False) == (3, None)
+    assert e.next_width(3, blamed=1, was_healthy=False) == (2, "degrade")
+    # a healthy degraded generation earns full width back
+    assert e.next_width(2, blamed=0, was_healthy=True) == (3, "scale_up")
+    # blame must be CONSECUTIVE on the same rank
+    e2 = ElasticState(_cfg(degrade_after=2), full_width=2)
+    assert e2.next_width(2, blamed=1, was_healthy=False) == (2, None)
+    assert e2.next_width(2, blamed=0, was_healthy=False) == (2, None)
+    assert e2.next_width(2, blamed=0, was_healthy=False) == (1, "degrade")
+
+
+# ------------------------------------------------------------- supervisor
+def test_flapping_child_exhausts_budget_and_leaves_full_report(tmp_path):
+    """Acceptance: a permanently failing child exits nonzero through
+    the supervisor and the report is complete and machine-readable."""
+    sup = Supervisor(
+        [sys.executable, "-c", "import sys; sys.exit(3)"],
+        run_dir=str(tmp_path), config=_cfg(max_restarts=2),
+        auto_resume=False,
+    )
+    code = sup.run()
+    assert code == 3
+    with open(tmp_path / REPORT_NAME) as fh:
+        report = json.load(fh)
+    assert report["final_status"] == "gave_up"
+    gens = report["generations"]
+    assert len(gens) == 3  # initial + 2 restarts, all classified
+    for g in gens:
+        assert g["exits"][0]["class"] == "error"
+        # the child never writes a record; the supervisor synthesizes
+        assert g["records"] and g["records"][0]["kind"].startswith(
+            "synthesized."
+        )
+    assert report["metrics"]["restarts"] == 2
+    assert report["metrics"]["records_synthesized"] == 3
+    assert METRICS.count("give_ups") == 1
+
+
+def test_sigkilled_child_is_classified_as_signal(tmp_path):
+    env = dict(os.environ, TEST_CHILD_PLAN="sigkill,ok")
+    sup = Supervisor(
+        [sys.executable, CHILD], run_dir=str(tmp_path), config=_cfg(),
+        auto_resume=False, env=env,
+    )
+    assert sup.run() == 0
+    with open(tmp_path / REPORT_NAME) as fh:
+        report = json.load(fh)
+    first = report["generations"][0]
+    assert first["exits"][0]["class"] == "signal"
+    assert first["exits"][0]["returncode"] == -signal.SIGKILL
+    (rec,) = first["records"]
+    assert rec["kind"] == "synthesized.signal"
+    assert "signal 9" in rec["reason"]
+
+
+def test_elastic_degrade_then_scale_up(tmp_path):
+    """Failures attributed to rank 1 twice -> relaunch one narrower
+    (with SPARKNET_ELASTIC_RESUME exported); a healthy degraded
+    generation earns the width back."""
+    env = dict(
+        os.environ,
+        TEST_CHILD_PLAN="crash1,crash1,healthy-crash,ok",
+        TEST_CHILD_HEALTHY_S="0.6",
+    )
+    sup = Supervisor(
+        [sys.executable, CHILD], num_procs=2, run_dir=str(tmp_path),
+        config=_cfg(), auto_resume=False, env=env,
+    )
+    assert sup.run() == 0
+    with open(tmp_path / REPORT_NAME) as fh:
+        report = json.load(fh)
+    gens = report["generations"]
+    assert [g["width"] for g in gens] == [2, 2, 1, 2]
+    assert [g["action"] for g in gens] == [None, None, "degrade", "scale_up"]
+    assert [g.get("blamed_rank") for g in gens[:3]] == [1, 1, 0]
+    # the degraded child saw the elastic-resume contract
+    assert sup._base_env["SPARKNET_ELASTIC_RESUME"] == "0"  # back at full
+    assert METRICS.count("degraded_relaunches") == 1
+    assert METRICS.count("scale_ups") == 1
+    assert METRICS.count("restarts") == 3
+
+
+def test_verify_resume_walks_past_torn_snapshot(tmp_path):
+    """supervisor.resume_torn chaos: the newest solverstate is torn
+    between crash and relaunch; the pre-relaunch verify must count it
+    and land on the older intact snapshot."""
+    from sparknet_tpu.solver import snapshot
+
+    prefix = str(tmp_path / "run")
+    for it in (2, 4):
+        snapshot.save_state(
+            f"{prefix}_iter_{it}.solverstate.npz",
+            tree={"w": np.arange(6, dtype=np.float32) + it}, it=it,
+        )
+    chaos.install("supervisor.resume_torn@index=0")
+    sup = Supervisor(
+        [sys.executable, "-c", "pass"], run_dir=str(tmp_path),
+        snapshot_prefix=prefix, config=_cfg(), auto_resume=False,
+    )
+    resume = sup._verify_resume(0)
+    assert resume is not None
+    it, path = resume
+    assert it == 2 and path.endswith("_iter_2.solverstate.npz")
+    # the newest really was torn by the chaos point
+    with pytest.raises(snapshot.SnapshotError):
+        snapshot.load_state(f"{prefix}_iter_4.solverstate.npz")
+    assert METRICS.count("torn_snapshots") == 1
+    assert METRICS.count("verified_resumes") == 1
+    assert chaos.METRICS.snapshot()["fires"]["supervisor.resume_torn"] == 1
+
+
+def test_strip_flag_both_spellings():
+    assert strip_flag(["a", "--chaos", "x", "b"], "--chaos", True) == ["a", "b"]
+    assert strip_flag(["a", "--chaos=x", "b"], "--chaos", True) == ["a", "b"]
+    assert strip_flag(["--supervise", "b"], "--supervise") == ["b"]
+    assert strip_flag(["b"], "--supervise") == ["b"]
+
+
+def test_relaunch_disarms_chaos_and_appends_auto_resume():
+    sup = Supervisor(
+        ["prog", "--chaos=supervisor.child_crash@after=4", "--x"],
+        num_procs=1,
+    )
+    assert sup._child_argv(0) == [
+        "prog", "--chaos=supervisor.child_crash@after=4", "--x"
+    ]
+    assert sup._child_argv(1) == ["prog", "--x", "--auto-resume"]
+    env = sup._child_env(1, 1, None)
+    assert env["SPARKNET_CHAOS"] == ""
+    assert env["SPARKNET_SUPERVISE"] == "0"  # children never recurse
+    assert env[records.GENERATION_ENV] == "1"
+
+
+def test_elastic_weights_only_restore_reinits_opt_state(tmp_path):
+    """The degraded relaunch's restore contract: params/iter/rng come
+    back, optimizer slots re-initialize (the snapshot's slots may be
+    laid out for a dp width that no longer exists)."""
+    import jax
+
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    sp_txt = (
+        'base_lr: 0.1\nlr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 8\n'
+    )
+
+    def make_solver():
+        sp = caffe_pb.load_solver(sp_txt, is_path=False)
+        sp.net_param = caffe_pb.load_net(NET_TXT, is_path=False)
+        return Solver(sp, {"data": (8, 6), "label": (8,)})
+
+    rng = np.random.default_rng(0)
+    batches = [
+        {
+            "data": rng.normal(size=(8, 6)).astype(np.float32),
+            "label": rng.integers(0, 10, 8).astype(np.int32),
+        }
+        for _ in range(2)
+    ]
+    s1 = make_solver()
+    s1.step(iter(batches), 2)
+    path = str(tmp_path / "st.solverstate.npz")
+    s1.save(path)
+
+    s2 = make_solver()
+    s2.restore(path, weights_only=True)
+    assert s2.iter == 2
+    for layer, leaves in jax.device_get(s1.params).items():
+        for name, v in leaves.items():
+            np.testing.assert_array_equal(
+                v, np.asarray(jax.device_get(s2.params)[layer][name])
+            )
+    # momentum slots are fresh zeros, not the snapshot's
+    mom1 = jax.device_get(s1.opt_state)["momentum"]["ip"]["weight"]
+    mom2 = jax.device_get(s2.opt_state)["momentum"]["ip"]["weight"]
+    assert np.any(mom1 != 0)
+    assert not np.any(mom2 != 0)
+
+
+# ------------------------------------------------------------ CLI e2e
+def _write_job(d, max_iter=8, snapshot=4):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "net.prototxt"), "w") as fh:
+        fh.write(NET_TXT)
+    with open(os.path.join(d, "solver.prototxt"), "w") as fh:
+        fh.write(
+            'net: "net.prototxt"\nbase_lr: 0.05\nlr_policy: "fixed"\n'
+            f'momentum: 0.9\nmax_iter: {max_iter}\nsnapshot: {snapshot}\n'
+            f'snapshot_prefix: "{d}/snap"\ndisplay: 0\n'
+        )
+    return [
+        f"--solver={d}/solver.prototxt", "--synthetic", "--synthetic-n=64",
+        "--batch-size=8", "--seed=3", "--data-workers=0",
+        "--native-loader=off",
+    ]
+
+
+def test_supervised_chaos_kill_resumes_bit_identical(tmp_path, monkeypatch,
+                                                     capfd):
+    """THE acceptance run: ``caffe train --supervise`` with a
+    supervisor.child_crash injection.  The child snapshots at iter 4,
+    hard-exits at the next boundary, the supervisor verifies the
+    snapshot and relaunches with --auto-resume (chaos disarmed), and
+    the final weights are bit-identical to an uninterrupted run."""
+    from sparknet_tpu.tools import caffe as caffe_cli
+
+    monkeypatch.setenv("SPARKNET_SUPERVISE_RESTARTS", "3")
+    monkeypatch.setenv("SPARKNET_SUPERVISE_BACKOFF", "0.05")
+    monkeypatch.setenv("SPARKNET_SUPERVISE_BACKOFF_CAP", "0.1")
+
+    d1 = str(tmp_path / "sup")
+    caffe_cli.main(
+        ["train", "--supervise",
+         "--chaos=supervisor.child_crash@after=4"] + _write_job(d1)
+    )
+    out = capfd.readouterr().out
+    assert '"restarts": 1' in out and '"verified_resumes": 1' in out
+    assert "supervisor:" in out  # the one JSON metrics line
+
+    # the machine-readable trail: report + the child's own crash record
+    with open(os.path.join(d1, REPORT_NAME)) as fh:
+        report = json.load(fh)
+    assert report["final_status"] == "done"
+    assert len(report["generations"]) == 2
+    assert report["generations"][1]["action"] is None  # same width back
+    assert report["generations"][0]["resume"]["iter"] == 4
+    (rec,) = records.read_failure_records(d1)
+    assert rec["kind"] == "chaos.child_crash"
+    assert rec["last_completed_iteration"] == 4
+
+    d2 = str(tmp_path / "clean")
+    caffe_cli.main(["train"] + _write_job(d2))
+
+    with np.load(f"{d1}/snap_iter_8.npz") as z:
+        supervised = {k: z[k].copy() for k in z.files}
+    with np.load(f"{d2}/snap_iter_8.npz") as z:
+        clean = {k: z[k].copy() for k in z.files}
+    assert sorted(supervised) == sorted(clean)
+    for k in clean:
+        np.testing.assert_array_equal(supervised[k], clean[k], err_msg=k)
+
+
+def test_unsupervised_train_path_has_zero_supervision_footprint(
+    tmp_path, capfd
+):
+    """--supervise off: no child processes, no failure records, no
+    supervisor line, no report — the train path is the PR-3-era one."""
+    from sparknet_tpu.tools import caffe as caffe_cli
+
+    d = str(tmp_path / "plain")
+    caffe_cli.main(["train"] + _write_job(d, max_iter=2, snapshot=2))
+    out = capfd.readouterr().out
+    assert "supervisor" not in out
+    assert not os.path.exists(os.path.join(d, "failures"))
+    assert not os.path.exists(os.path.join(d, REPORT_NAME))
+    assert METRICS.snapshot() == {}
+
+
+def test_sparknet_supervise_console_entry_resolves():
+    from sparknet_tpu.supervise import supervisor as mod
+
+    assert callable(mod.main)
+    with pytest.raises(SystemExit):  # no command -> usage error
+        mod.main([])
