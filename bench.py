@@ -152,6 +152,34 @@ def _dispatch_ms(n: int = 30) -> float | None:
         return None
 
 
+def _attach_bench_timeline(solver) -> None:
+    """Attach an unfenced telemetry timeline to a bench solver: every
+    ``step()``-driven measurement (warmup, e2e sub-records) attributes
+    its phases, and the record's ``telemetry`` block carries the
+    breakdown.  ``fence=False`` so attribution never perturbs the
+    timing being measured (scanned headline timings bypass step() and
+    are unaffected either way)."""
+    from sparknet_tpu.telemetry import timeline as _ttl
+
+    solver.timeline = _ttl.Timeline(fence=False)
+    _ttl.set_current(solver.timeline)
+    solver.timeline.start()
+
+
+def _telemetry_record() -> dict:
+    """The self-explaining tail of every BENCH_*.json record: the full
+    registry snapshot (pipeline/chaos/serve sources included) plus the
+    bench solver's step-phase breakdown."""
+    from sparknet_tpu.telemetry import REGISTRY
+    from sparknet_tpu.telemetry import timeline as _ttl
+
+    tl_snap = _ttl.current().snapshot()
+    return {
+        "registry": REGISTRY.snapshot(),
+        "timeline": tl_snap or None,
+    }
+
+
 def _scan_enabled(platform: str) -> bool:
     """Compute-only accelerator timing defaults to ONE scanned dispatch
     for all iters: a degraded tunnel costs ~100 ms round-trip PER
@@ -262,6 +290,7 @@ def bench_imagenet(
                 bench_tf.device_fn() if pipeline_mode == "device" else None
             ),
         )
+    _attach_bench_timeline(solver)
 
     def e2e_feed(mode: str, workers: int = 0):
         """Fresh host batches through the real preprocessing path,
@@ -522,6 +551,7 @@ def bench_bert(platform: str) -> dict:
         momentum=0.9, weight_decay=0.01, max_iter=100,
     )
     solver = Solver(sp, shapes, model=model)
+    _attach_bench_timeline(solver)
 
     ds, vs = mlm_dataset(vocab_size=cfg.vocab_size, n_tokens=seq * bs * 4,
                          seq_len=seq)
@@ -610,6 +640,9 @@ def main() -> None:
         out["dispatch_ms"] = _dispatch_ms()
     if _PROBE_NOTE:
         out["backend_probe"] = _PROBE_NOTE
+    # every record carries the telemetry snapshot (registry sources +
+    # step-phase breakdown) so the perf trajectory is self-explaining
+    out["telemetry"] = _telemetry_record()
     print(json.dumps(out))
 
 

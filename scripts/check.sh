@@ -61,5 +61,70 @@ if [[ $rc -ne 0 && -z "$failures" ]]; then
   exit "$rc"
 fi
 
+# ---- telemetry lint: ad-hoc time.perf_counter metric plumbing belongs
+# in sparknet_tpu/telemetry/ now.  Per-file counts are frozen in
+# scripts/perf_counter_allowlist.txt ("count path"); a NEW file using
+# perf_counter, or more uses in an existing file, fails — decreases and
+# telemetry/ itself are fine.
+ALLOW=scripts/perf_counter_allowlist.txt
+pc_now=$(grep -rc "perf_counter" sparknet_tpu --include='*.py' \
+  | grep -v ":0$" | grep -v "^sparknet_tpu/telemetry/" \
+  | awk -F: '{print $2, $1}' | sort -k2)
+pc_bad=$(awk 'NR==FNR { if ($1 ~ /^#/) next; allowed[$2]=$1; next }
+              { if (!($2 in allowed) || $1 > allowed[$2])
+                  printf "  %s: %d uses (allowed %d)\n", $2, $1, allowed[$2] }' \
+  "$ALLOW" <(printf '%s\n' "$pc_now"))
+if [[ -n "$pc_bad" ]]; then
+  echo "check.sh: perf_counter LINT — new ad-hoc timing outside sparknet_tpu/telemetry/:"
+  printf '%s\n' "$pc_bad"
+  echo "  (route new metrics through the telemetry registry/tracer, or consciously bump $ALLOW)"
+  exit 1
+fi
+echo "check.sh: perf_counter lint clean (counts within $ALLOW)"
+
+# ---- telemetry smoke: 5 CPU train iters with --trace must emit a valid
+# Chrome trace (Perfetto schema basics) and a nonempty step-time table
+SMOKE_DIR=$(mktemp -d /tmp/_telemetry_smoke.XXXXXX)
+SMOKE_LOG="$SMOKE_DIR/smoke.log"
+cat > "$SMOKE_DIR/net.prototxt" <<'EOF'
+name: "smoke"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+EOF
+cat > "$SMOKE_DIR/solver.prototxt" <<EOF
+net: "net.prototxt"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 5
+display: 0
+snapshot_prefix: "$SMOKE_DIR/snap"
+EOF
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m sparknet_tpu.tools.caffe train \
+    "--solver=$SMOKE_DIR/solver.prototxt" --synthetic --synthetic-n=64 \
+    --batch-size=8 --data-workers=0 --native-loader=off \
+    "--trace=$SMOKE_DIR/trace.json" > "$SMOKE_LOG" 2>&1 \
+  && grep -q "step-time breakdown" "$SMOKE_LOG" \
+  && grep -qE "compiled_step +[0-9]" "$SMOKE_LOG" \
+  && python - "$SMOKE_DIR/trace.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+evs = d["traceEvents"]
+assert evs, "empty traceEvents"
+for e in evs:
+    assert e["ph"] in ("X", "M") and "pid" in e and "tid" in e and "name" in e, e
+EOF
+then
+  echo "check.sh: telemetry smoke OK (valid trace + step-time table)"
+  rm -rf "$SMOKE_DIR"
+else
+  echo "check.sh: telemetry SMOKE FAILED — log tail:"
+  tail -20 "$SMOKE_LOG"
+  exit 1
+fi
+
 echo "check.sh: OK — no new failures ($(printf '%s\n' "$failures" | sed '/^$/d' | wc -l) known)"
 exit 0

@@ -378,6 +378,10 @@ def parser() -> argparse.ArgumentParser:
                          "solverstate if one exists (preemption recovery)")
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="host-side span trace + step-time breakdown "
+                         "(Solver modes; Chrome trace-event JSON, also "
+                         "SPARKNET_TRACE; docs/OBSERVABILITY.md)")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="batches staged ahead on device (0 disables)")
     ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
@@ -430,10 +434,22 @@ def main(argv=None) -> Dict[str, float]:
         items_per_step=args.batch_size * solver.train_net.seq_len,
         unit="tokens",
     )
+    from .. import telemetry
+
+    # --trace / SPARKNET_TRACE: span tracer + step-time attribution on
+    # the Solver path (see cifar_app.main; docs/OBSERVABILITY.md)
+    telemetry.install_for_training(solver, args.trace)
     t0 = time.time()
     metrics = {}
-    with trace(args.profile_dir):
-        metrics = _fit(solver, feed, args, timer, primary)
+    try:
+        # the telemetry bracket also runs the periodic telemetry: line
+        # (SPARKNET_TELEMETRY_INTERVAL_S) like cifar_app.train_loop
+        with trace(args.profile_dir), telemetry.training_loop(
+            solver.timeline, emit=print
+        ):
+            metrics = _fit(solver, feed, args, timer, primary)
+    finally:
+        telemetry.finish_run()
     dt = time.time() - t0
     if primary:
         done_iters = solver.iter  # may be < max_iter after a preemption
@@ -441,6 +457,11 @@ def main(argv=None) -> Dict[str, float]:
             f"Optimization Done. {done_iters} iters in {dt:.1f}s "
             f"({done_iters / max(dt, 1e-9):.1f} it/s)"
         )
+        tl = solver.timeline
+        if tl.enabled:
+            print("telemetry: step-time breakdown")
+            for line in tl.table().splitlines():
+                print(f"  {line}")
     multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return metrics
 

@@ -318,7 +318,12 @@ def train_loop(
     solver: Solver, train_feed, test_feed, log=print, timer=None
 ) -> Dict[str, float]:
     from .. import chaos
+    from ..telemetry import timeline as _ttl
     from ..utils.profiling import StepTimer
+
+    # per-iteration phase attribution: NULL unless the app enabled it
+    # (--trace / SPARKNET_TIMELINE; telemetry.install_for_training)
+    tl = getattr(solver, "timeline", _ttl.NULL)
 
     # supervisor.child_crash injection site (checked once per loop
     # chunk, i.e. at test/snapshot boundaries — not per iteration);
@@ -346,22 +351,28 @@ def train_loop(
             f"{sp.snapshot_prefix}_iter_{solver.iter}"
             f"{solver.snapshot_suffix}"
         )
-        # collective (gathers host-sharded optimizer slots); every
-        # process participates, only process 0 writes the files
-        solver.save(state_path)
-        if multihost.is_primary():
-            W.save_npz(path, solver.params)
-            # keep-last-k (SPARKNET_SNAPSHOT_KEEP): bounds disk growth
-            # while leaving older snapshots for torn-file fallback
-            from ..solver.snapshot import prune_snapshots
+        with tl.phase("snapshot"):
+            # collective (gathers host-sharded optimizer slots); every
+            # process participates, only process 0 writes the files
+            solver.save(state_path)
+            if multihost.is_primary():
+                W.save_npz(path, solver.params)
+                # keep-last-k (SPARKNET_SNAPSHOT_KEEP): bounds disk
+                # growth while leaving older snapshots for torn-file
+                # fallback
+                from ..solver.snapshot import prune_snapshots
 
-            prune_snapshots(sp.snapshot_prefix)
+                prune_snapshots(sp.snapshot_prefix)
         log(f"Snapshotting to {path}")
         log(f"Snapshotting solver state to {state_path}")
 
     from ..solver.preempt import preempt_message, preemption_grace
+    from ..telemetry import training_loop as _telemetry_loop
 
-    with preemption_grace(solver):
+    # telemetry bracket: timeline wall clock + the periodic
+    # ``telemetry:`` line (SPARKNET_TELEMETRY_INTERVAL_S, default off)
+    # so long supervised runs surface numbers before exit
+    with _telemetry_loop(tl, emit=log), preemption_grace(solver):
         # Caffe's pre-loop gate (Solver::Step):
         # iter % test_interval == 0 && (iter > 0 || test_initialization)
         # — a fresh solver tests once before training unless
@@ -371,7 +382,8 @@ def train_loop(
             (solver.iter == 0 and sp.test_initialization)
             or (solver.iter > 0 and solver.iter % sp.test_interval == 0)
         ):
-            last_test = solver.test(test_feed)
+            with tl.phase("eval"):
+                last_test = solver.test(test_feed)
             for k, v in last_test.items():
                 log(f"    Test net output: {k} = {v:.4f}")
         while solver.iter < sp.max_iter:
@@ -428,7 +440,8 @@ def train_loop(
             if (
                 sp.test_interval and solver.iter % sp.test_interval == 0
             ) or at_end:
-                last_test = solver.test(test_feed)
+                with tl.phase("eval"):
+                    last_test = solver.test(test_feed)
                 for k, v in last_test.items():
                     log(f"    Test net output: {k} = {v:.4f}")
             if (
@@ -443,6 +456,13 @@ def train_loop(
         f"Optimization Done. {done_iters} iters in {dt:.1f}s "
         f"({done_iters / max(dt, 1e-9):.1f} it/s)"
     )
+    if tl.enabled:
+        # the paper's τ-vs-communication accounting, read off the live
+        # loop: input wait / H2D / multihost sync / fenced compute /
+        # eval / snapshot, exclusive times (docs/OBSERVABILITY.md)
+        log("telemetry: step-time breakdown")
+        for line in tl.table().splitlines():
+            log(f"  {line}")
     return last_test
 
 
@@ -486,6 +506,13 @@ def arg_parser() -> argparse.ArgumentParser:
                     help="initialise weights from a .caffemodel (finetune)")
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="host-side span trace + step-time breakdown: "
+                         "write Chrome trace-event JSON (Perfetto-"
+                         "loadable; pipeline workers and supervised "
+                         "children merge in by pid/tid) and print the "
+                         "per-phase step-time table (also "
+                         "SPARKNET_TRACE; docs/OBSERVABILITY.md)")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="batches staged ahead on device (0 disables)")
     ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
@@ -593,8 +620,12 @@ def main(argv=None):
             f"CifarApp: net={solver.net_param.name} params="
             f"{W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
         )
+    from .. import telemetry
     from ..utils.profiling import trace
 
+    # --trace / SPARKNET_TRACE / SPARKNET_TIMELINE: span tracer +
+    # step-time attribution (docs/OBSERVABILITY.md)
+    telemetry.install_for_training(solver, args.trace)
     try:
         with trace(args.profile_dir):
             result = train_loop(solver, train_feed, test_feed)
@@ -618,6 +649,9 @@ def main(argv=None):
             # fires + recoveries, one JSON line — the chaos run's
             # observable record (tests assert exact counts on it)
             print(f"chaos: {chaos.METRICS.json_line()}")
+        # AFTER the feed close: the joined workers' span sidecars are
+        # on disk, so the merged Chrome trace includes them
+        telemetry.finish_run()
     # training is done: leave the liveness fabric gracefully so the
     # last host to finish isn't mistaken for a dead peer
     multihost.stop_heartbeat()

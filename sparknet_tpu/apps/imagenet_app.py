@@ -280,6 +280,13 @@ def parser() -> argparse.ArgumentParser:
                     help="initialise weights from a .caffemodel (finetune)")
     ap.add_argument("--profile-dir", default=None,
                     help="dump a jax.profiler trace of the training loop")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="host-side span trace + step-time breakdown: "
+                         "write Chrome trace-event JSON (Perfetto-"
+                         "loadable; pipeline workers and supervised "
+                         "children merge in by pid/tid) and print the "
+                         "per-phase step-time table (also "
+                         "SPARKNET_TRACE; docs/OBSERVABILITY.md)")
     ap.add_argument("--prefetch", type=int, default=2,
                     help="batches staged ahead on device (0 disables)")
     ap.add_argument("--snapshot-format", choices=("npz", "orbax"),
@@ -353,8 +360,12 @@ def main(argv=None):
             f"ImageNetApp: net={solver.net_param.name} "
             f"params={W.num_params(solver.params)} max_iter={solver.sp.max_iter}"
         )
+    from .. import telemetry
     from ..utils.profiling import trace
 
+    # --trace / SPARKNET_TRACE / SPARKNET_TIMELINE wiring (see
+    # cifar_app.main; docs/OBSERVABILITY.md)
+    telemetry.install_for_training(solver, args.trace)
     try:
         with trace(args.profile_dir):
             result = train_loop(solver, train_feed, test_feed)
@@ -374,6 +385,9 @@ def main(argv=None):
         getattr(raw_train_feed, "close", lambda: None)()
         if chaos.active() and multihost.is_primary():
             print(f"chaos: {chaos.METRICS.json_line()}")
+        # after the feed close: worker span sidecars are on disk for
+        # the merged Chrome trace (see cifar_app.main)
+        telemetry.finish_run()
     multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return result
 

@@ -3,10 +3,13 @@
 One process-global :class:`ChaosMetrics` registry counts every fault
 *fire* (per fault point) and every *recovery* (per recovery action —
 ``pipeline.worker_respawn``, ``serve.client_retry``,
-``snapshot.fallback_restore``), reusing the serving stack's
-:class:`~sparknet_tpu.serve.metrics.Counter` primitive and its
-one-JSON-line dump discipline.  The apps print the line at the end of a
-chaos-enabled run; tests assert exact recovery counts against it.
+``snapshot.fallback_restore``), built on the telemetry registry's
+:class:`~sparknet_tpu.telemetry.registry.NamedCounters` (the shared
+name->Counter table this module and ``supervise/metrics.py`` used to
+re-implement separately) and its one-JSON-line dump discipline.  The
+apps print the line at the end of a chaos-enabled run; tests assert
+exact recovery counts against it; ``telemetry.REGISTRY.snapshot()``
+carries the same dicts under the ``"chaos"`` source.
 
 Note: fires at fault points that live inside *forked worker processes*
 (the pipeline points) are counted in the worker's copy of this registry
@@ -18,54 +21,39 @@ and the acceptance criteria assert on.
 from __future__ import annotations
 
 import json
-import threading
-from typing import Dict
 
-from ..serve.metrics import Counter
+from ..telemetry.registry import REGISTRY, NamedCounters
 
 
 class ChaosMetrics:
     """Fires per fault point + recoveries per recovery action."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self.fires: Dict[str, Counter] = {}
-        self.recoveries: Dict[str, Counter] = {}
-
-    def _get(self, table: Dict[str, Counter], name: str) -> Counter:
-        with self._lock:
-            c = table.get(name)
-            if c is None:
-                c = table[name] = Counter()
-            return c
+        self.fires = NamedCounters()
+        self.recoveries = NamedCounters()
 
     def record_fire(self, point: str) -> None:
-        self._get(self.fires, point).inc()
+        self.fires.inc(point)
 
     def record_recovery(self, name: str) -> None:
-        self._get(self.recoveries, name).inc()
+        self.recoveries.inc(name)
 
     def recovery_count(self, name: str) -> int:
-        with self._lock:
-            c = self.recoveries.get(name)
-        return c.snapshot() if c is not None else 0
+        return self.recoveries.count(name)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "fires": {k: c.snapshot() for k, c in self.fires.items()},
-                "recoveries": {
-                    k: c.snapshot() for k, c in self.recoveries.items()
-                },
-            }
+        return {
+            "fires": self.fires.snapshot(),
+            "recoveries": self.recoveries.snapshot(),
+        }
 
     def json_line(self) -> str:
         return json.dumps(self.snapshot())
 
     def reset(self) -> None:
-        with self._lock:
-            self.fires.clear()
-            self.recoveries.clear()
+        self.fires.reset()
+        self.recoveries.reset()
 
 
 METRICS = ChaosMetrics()
+REGISTRY.register_source("chaos", METRICS)

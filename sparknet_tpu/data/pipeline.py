@@ -56,8 +56,9 @@ the batch stream:
   at its serial position — respawning would just hit the same bug.
   Every respawn increments ``PipelineMetrics.worker_respawns`` and the
   chaos registry's ``pipeline.worker_respawn`` recovery counter.
-- **Observability.** :class:`PipelineMetrics` reuses the serving
-  gauge/histogram primitives (``serve/metrics.py``) to expose per-stage
+- **Observability.** :class:`PipelineMetrics` reuses the telemetry
+  gauge/histogram primitives (``telemetry/registry.py``, where the
+  serving metrics' primitives now live) to expose per-stage
   wait time (worker blocked on a free slot; consumer blocked waiting
   for the next in-order batch) and queue occupancy, so ``bench.py`` and
   the apps can report host-bound vs device-bound directly: a consumer
@@ -92,7 +93,8 @@ from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
-from ..serve.metrics import Gauge, LatencyHistogram
+from ..telemetry import trace as _trace
+from ..telemetry.registry import REGISTRY, Gauge, LatencyHistogram
 
 # /dev/shm name prefix; the tests' leak fixture greps for it
 SHM_PREFIX = "snpipe"
@@ -145,6 +147,10 @@ class PipelineMetrics:
         self.consumer_wait = LatencyHistogram()
         self.reorder_depth = Gauge()  # batches parked awaiting their turn
         self.slots_free = Gauge()
+        # the telemetry registry's "pipeline" source: the periodic
+        # telemetry: line and bench records see the live pipeline
+        # without extra wiring (weakly held — dies with the pipeline)
+        REGISTRY.register_source("pipeline", self)
 
     # ------------------------------------------------------------- writes
     def record_batch(
@@ -243,14 +249,16 @@ def _worker_main(
                         float(rule.params.get("delay_ms", 50.0)) / 1e3
                     )
             t0 = time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                result_q.put(("done", rank))
-                return
-            arrs = {
-                k: np.ascontiguousarray(v) for k, v in batch.items()
-            }
+            with _trace.span("pipeline.produce", cat="pipeline",
+                             batch=seq, worker=rank):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    result_q.put(("done", rank))
+                    return
+                arrs = {
+                    k: np.ascontiguousarray(v) for k, v in batch.items()
+                }
             produce_s = time.perf_counter() - t0
             rows = len(next(iter(arrs.values())))
             total, metas = _layout(arrs)
@@ -293,6 +301,13 @@ def _worker_main(
         except Exception:
             pass
     finally:
+        try:
+            # multiprocessing children skip atexit: dump this worker's
+            # spans for the owner's merged Chrome trace (no-op when
+            # tracing is off; chaos os._exit deaths simply lose theirs)
+            _trace.flush_sidecar()
+        except Exception:
+            pass
         for shm in shms.values():
             try:
                 shm.close()

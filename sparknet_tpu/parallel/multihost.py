@@ -35,6 +35,9 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..telemetry import timeline as _timeline
+from ..telemetry import trace as _trace
+
 # Exit code for "a peer host stopped responding": the launcher (or
 # scripts/launch_multihost.sh + --auto-resume) treats any non-zero exit
 # as restart-the-job. Distinct from ordinary crashes to aid triage.
@@ -475,10 +478,18 @@ def host_shard(ds):
 def put_global(batch: Any, sharding: jax.sharding.NamedSharding) -> Any:
     """Assemble per-host local rows into one globally-sharded array
     pytree.  Each process passes its own rows; process order defines
-    global order along the sharded axis."""
-    return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(
-            sharding, np.asarray(x)
-        ),
-        batch,
-    )
+    global order along the sharded axis.
+
+    This is the host-path cross-host rendezvous (every process must
+    arrive before the global array exists), so the active timeline
+    attributes it as ``multihost_sync`` — nested inside the solver's
+    ``device_put`` phase, which then reports only its exclusive H2D
+    time — and the tracer records a span per call."""
+    with _trace.span("multihost.put_global", cat="multihost"), \
+            _timeline.current_phase("multihost_sync"):
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )

@@ -188,27 +188,41 @@ class ParallelSolver(Solver):
             return super().step(batches, n, log_fn)
         metrics: Dict[str, Any] = {}
         end = self.iter + n
+        tl = self.timeline  # same phase brackets as Solver.step: one
+        # local-SGD round = tau iterations in one compiled dispatch, so
+        # compiled_step here covers the whole round incl. the τ-sync
+        # weight average (the on-device communication the paper's τ
+        # analysis amortizes); put_global attributes multihost_sync
         while self.iter < end:
             if self.stop_requested:
                 break
             tau = min(self.tau, end - self.iter)
-            stacked = stack_round_batches(
-                [self._next_iteration_batch(batches) for _ in range(tau)]
-            )
-            if self._multihost:
-                stacked = multihost.put_global(stacked, self._batch_sharding)
-            else:
-                stacked = jax.device_put(stacked, self._batch_sharding)
-            self.rng, step_rng = jax.random.split(self.rng)
-            prev = self.iter
-            self.params, self.state, self.opt_state, metrics = self._round_fn(tau)(
-                self.params,
-                self.state,
-                self.opt_state,
-                stacked,
-                jnp.asarray(self.iter, jnp.int32),
-                step_rng,
-            )
+            with tl.phase("input_wait"):
+                stacked = stack_round_batches(
+                    [self._next_iteration_batch(batches) for _ in range(tau)]
+                )
+            with tl.phase("device_put"):
+                if self._multihost:
+                    stacked = multihost.put_global(
+                        stacked, self._batch_sharding
+                    )
+                else:
+                    stacked = jax.device_put(stacked, self._batch_sharding)
+            with tl.phase("compiled_step"):
+                self.rng, step_rng = jax.random.split(self.rng)
+                prev = self.iter
+                self.params, self.state, self.opt_state, metrics = (
+                    self._round_fn(tau)(
+                        self.params,
+                        self.state,
+                        self.opt_state,
+                        stacked,
+                        jnp.asarray(self.iter, jnp.int32),
+                        step_rng,
+                    )
+                )
+                if tl.fence:
+                    jax.block_until_ready(metrics)
             self.iter += tau
             d = self.sp.display
             if log_fn and d:

@@ -36,6 +36,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..telemetry import trace as _trace
+
 
 class Backpressure(RuntimeError):
     """Raised by submit() when the bounded request queue is full."""
@@ -186,12 +188,14 @@ class MicroBatcher:
             return
         batch = live
         try:
-            if len(batch) == 1:
-                out = self.engine.infer(batch[0].rows)
-            else:
-                out = self.engine.infer(
-                    np.concatenate([it.rows for it in batch])
-                )
+            with _trace.span("serve.flush", cat="serve",
+                             requests=len(batch), rows=total):
+                if len(batch) == 1:
+                    out = self.engine.infer(batch[0].rows)
+                else:
+                    out = self.engine.infer(
+                        np.concatenate([it.rows for it in batch])
+                    )
         except Exception as e:
             if self.metrics is not None:
                 self.metrics.record_error(len(batch))

@@ -32,6 +32,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import trace as _trace
+
 Rows = Union[np.ndarray, Dict[str, np.ndarray]]
 
 
@@ -228,7 +230,9 @@ class InferenceEngine:
                 dev[name] = jnp.asarray(chunk, self._input_dtype(name))
             exe = self._executable(bucket)
             t0 = time.perf_counter()
-            out = np.asarray(exe(dev))  # np.asarray is the device fence
+            with _trace.span("serve.infer", cat="serve",
+                             bucket=bucket, rows=take):
+                out = np.asarray(exe(dev))  # np.asarray is the device fence
             if self.metrics is not None:
                 self.metrics.record_batch(
                     bucket,

@@ -1,122 +1,37 @@
-"""Serving observability: counters, latency histograms, gauges.
+"""Serving observability: the serving-specific metrics registry.
 
 Same discipline as ``bench.py`` records and ``utils/profiling``'s
 StepTimer: everything is windowed against wall-clock and dumpable as
-ONE JSON line, so a sweep log line or a ``/metrics`` scrape carries the
-whole serving picture — request/error counts, per-bucket batch counts
-and padding waste, p50/p95/p99 latencies, queue depth — without any
-external metrics stack.
+ONE JSON line, so a sweep log line or a ``/metrics.json`` scrape
+carries the whole serving picture — request/error counts, per-bucket
+batch counts and padding waste, p50/p95/p99 latencies, queue depth —
+without any external metrics stack.  ``GET /metrics`` additionally
+serves the same state in Prometheus text format via
+``telemetry/exporter.py``.
 
-Histograms are fixed log-spaced bins (~1.47x steps, 10 µs .. ~5 min),
-so ``observe`` is O(log n_bins) with no allocation and percentiles are
-exact to bin resolution (<50% relative error worst-case, far less in
-the ms range serving lives in). All mutators are lock-protected; the
-batcher's worker, HTTP handler threads and load-generator threads all
-write concurrently.
+The primitives (``Counter``/``Gauge``/``LatencyHistogram``) moved to
+:mod:`sparknet_tpu.telemetry.registry` — this grew from the serving
+stack into the process-wide substrate — and are re-exported here
+unchanged for back-compat (deprecated import path; new code should
+import from ``sparknet_tpu.telemetry``).
 """
 
 from __future__ import annotations
 
-import bisect
 import json
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict
 
-# ~1.47x geometric ladder: 10 µs -> ~300 s in 44 bins
-_BOUNDS_US: List[float] = []
-_b = 10.0
-while _b < 300e6:
-    _BOUNDS_US.append(round(_b, 1))
-    _b *= 1.468
-
-
-class LatencyHistogram:
-    """Log-binned latency histogram with percentile readout."""
-
-    def __init__(self):
-        self.counts = [0] * (len(_BOUNDS_US) + 1)
-        self.n = 0
-        self.total_us = 0.0
-
-    def observe(self, seconds: float) -> None:
-        us = max(seconds, 0.0) * 1e6
-        self.counts[bisect.bisect_left(_BOUNDS_US, us)] += 1
-        self.n += 1
-        self.total_us += us
-
-    def percentile(self, q: float) -> Optional[float]:
-        """Upper bound (µs) of the bin holding the q-quantile, or None
-        when empty. q in [0, 1]."""
-        if not self.n:
-            return None
-        target = q * self.n
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= target and c:
-                return (
-                    _BOUNDS_US[i] if i < len(_BOUNDS_US) else _BOUNDS_US[-1]
-                )
-        return _BOUNDS_US[-1]
-
-    def snapshot(self) -> dict:
-        def ms(v):
-            return None if v is None else round(v / 1000, 3)
-
-        return {
-            "count": self.n,
-            "mean_ms": ms(self.total_us / self.n) if self.n else None,
-            "p50_ms": ms(self.percentile(0.50)),
-            "p95_ms": ms(self.percentile(0.95)),
-            "p99_ms": ms(self.percentile(0.99)),
-        }
-
-
-class Counter:
-    """Lock-protected monotone event counter — the simplest shared
-    primitive (chaos fires/recoveries, shed requests).  Gauge tracks a
-    level; Counter only ever goes up."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.n = 0
-
-    def inc(self, d: int = 1) -> None:
-        with self._lock:
-            self.n += d
-
-    def snapshot(self) -> int:
-        with self._lock:
-            return self.n
-
-
-class Gauge:
-    """Current value + high-water mark. The generic occupancy primitive
-    (queue depth, buffer fill, slots in flight) shared by the serving
-    metrics here and the input-pipeline metrics in ``data/pipeline.py``.
-    Lock-protected: producers, consumers and snapshot readers race."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.value = 0
-        self.max = 0
-
-    def set(self, v) -> None:
-        with self._lock:
-            self.value = v
-            if v > self.max:
-                self.max = v
-
-    def add(self, d) -> None:
-        with self._lock:
-            self.value += d
-            if self.value > self.max:
-                self.max = self.value
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {"value": self.value, "max": self.max}
+# Deprecated re-export location: the primitives live in
+# telemetry/registry.py now.  Kept so every historical
+# ``from sparknet_tpu.serve.metrics import Counter`` keeps working.
+from ..telemetry.registry import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    LatencyHistogram,
+)
 
 
 class ServeMetrics:
@@ -144,6 +59,10 @@ class ServeMetrics:
         self.per_bucket: Dict[int, dict] = {}
         for b in buckets:
             self._bucket(int(b))
+        # the process registry's "serve" source: telemetry.snapshot()
+        # and the periodic flush line carry this registry too (weakly
+        # referenced — a dropped server takes its metrics with it)
+        REGISTRY.register_source("serve", self)
 
     def _bucket(self, bucket: int) -> dict:
         entry = self.per_bucket.get(bucket)

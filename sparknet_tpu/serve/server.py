@@ -9,7 +9,13 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
 - ``GET /healthz`` — liveness + model identity + bucket config; the
   ``status`` field degrades to ``"degraded"`` while requests are being
   shed/cancelled (deadline pressure), so balancers can back off.
-- ``GET /metrics`` — the ServeMetrics snapshot, one JSON object.
+- ``GET /metrics`` — Prometheus text format (0.0.4): the process-wide
+  telemetry registry plus the serving families (request/error/shed
+  counters, queue-depth gauge, request/device latency histograms) —
+  a standard scrape target (docs/OBSERVABILITY.md).
+- ``GET /metrics.json`` — the ServeMetrics snapshot, one JSON object
+  (the former ``/metrics`` payload; sweep logs and ``Client.metrics``
+  use this).
 
 The server is a ``ThreadingHTTPServer``: handler threads block on the
 batcher future while the single batcher worker feeds the device, so
@@ -81,9 +87,12 @@ class InferenceServer:
                 pass
 
             def _reply(self, code: int, payload: dict, headers=()):
-                body = json.dumps(payload).encode()
+                self._send(code, json.dumps(payload).encode(),
+                           "application/json", headers)
+
+            def _send(self, code, body, ctype, headers=()):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in headers:
                     self.send_header(k, v)
@@ -106,6 +115,18 @@ class InferenceServer:
                         },
                     )
                 elif self.path == "/metrics":
+                    # Prometheus text exposition: the process registry
+                    # + the serving families (telemetry/exporter.py)
+                    from ..telemetry.exporter import render_prometheus
+
+                    self._send(
+                        200,
+                        render_prometheus(
+                            serve_metrics=outer.metrics
+                        ).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/metrics.json":
                     self._reply(200, outer.metrics.snapshot())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -329,7 +350,8 @@ class Client:
         return self._request("GET", "/healthz")
 
     def metrics(self):
-        return self._request("GET", "/metrics")
+        """The JSON snapshot (the Prometheus text lives at /metrics)."""
+        return self._request("GET", "/metrics.json")
 
     def classify(self, rows, top_k: int = 5):
         rows = np.asarray(rows)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..proto import caffe_pb
 from ..nets.xlanet import XLANet
+from ..telemetry import timeline as _timeline
 from .caffe_solver import init_opt_state, make_update_fn, mults_for_params
 
 
@@ -252,6 +253,11 @@ class Solver:
         from ..supervise import records
 
         records.publish_progress(self)
+        # per-iteration phase attribution (telemetry/timeline.py): the
+        # apps swap in an enabled Timeline under --trace /
+        # SPARKNET_TIMELINE=1; the default NULL costs one falsy test
+        # per phase boundary
+        self.timeline = _timeline.NULL
         # average_loss display smoothing; deque(maxlen) evicts itself
         self._loss_window = deque(maxlen=max(1, solver.average_loss))
         kw = step_compile_kw()
@@ -272,26 +278,41 @@ class Solver:
         (device arrays are held lazily; the float() sync happens only
         at display boundaries)."""
         metrics = {}
+        tl = self.timeline
         for _ in range(n):
             if self.stop_requested:
                 break
-            if self.sp.iter_size > 1:
-                micro = [next(batches) for _ in range(self.sp.iter_size)]
-                batch = jax.tree_util.tree_map(
-                    lambda *xs: jnp.stack(xs), *micro
+            # phase boundaries (telemetry/timeline.py): host blocked on
+            # the feed -> placement/global assembly -> the compiled
+            # step.  With the NULL timeline each bracket is a no-op
+            # context manager; an enabled one accumulates exclusive
+            # per-phase time and (fence=True) block_until_ready-fences
+            # the step so async dispatch can't smear compute into the
+            # next iteration's input_wait.
+            with tl.phase("input_wait"):
+                if self.sp.iter_size > 1:
+                    micro = [next(batches) for _ in range(self.sp.iter_size)]
+                    batch = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *micro
+                    )
+                else:
+                    batch = next(batches)
+            with tl.phase("device_put"):
+                batch = self._put_batch(batch)
+            with tl.phase("compiled_step"):
+                self.rng, step_rng = jax.random.split(self.rng)
+                self.params, self.state, self.opt_state, metrics = (
+                    self._train_step(
+                        self.params,
+                        self.state,
+                        self.opt_state,
+                        batch,
+                        jnp.asarray(self.iter, jnp.int32),
+                        step_rng,
+                    )
                 )
-            else:
-                batch = next(batches)
-            batch = self._put_batch(batch)
-            self.rng, step_rng = jax.random.split(self.rng)
-            self.params, self.state, self.opt_state, metrics = self._train_step(
-                self.params,
-                self.state,
-                self.opt_state,
-                batch,
-                jnp.asarray(self.iter, jnp.int32),
-                step_rng,
-            )
+                if tl.fence:
+                    jax.block_until_ready(metrics)
             self.iter += 1
             if log_fn and self.sp.display:
                 self._push_loss(metrics)

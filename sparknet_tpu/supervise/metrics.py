@@ -1,53 +1,31 @@
 """Supervisor observability: one ``supervisor:`` JSON line per run.
 
-Same discipline as the chaos and serving registries (and built on the
-same :class:`~sparknet_tpu.serve.metrics.Counter` primitive): every
-recovery-loop action — relaunches, elastic degrades and scale-ups,
-torn snapshots skipped by the pre-relaunch verify, records synthesized
-for children that died too hard to write their own — is counted
-process-globally and dumped as ONE JSON line when the supervisor
-finishes (cleanly or by giving up), so a log line carries the whole
-recovery story and tests can assert exact counts on it.
+Same discipline as the chaos and serving registries, and now literally
+the same table: every recovery-loop action — relaunches, elastic
+degrades and scale-ups, torn snapshots skipped by the pre-relaunch
+verify, records synthesized for children that died too hard to write
+their own — is counted in a process-global
+:class:`~sparknet_tpu.telemetry.registry.NamedCounters` (the shared
+name->Counter shape this module used to duplicate) and dumped as ONE
+JSON line when the supervisor finishes (cleanly or by giving up), so a
+log line carries the whole recovery story and tests can assert exact
+counts on it.  ``telemetry.REGISTRY.snapshot()`` carries the same
+dict under the ``"supervisor"`` source.
 """
 
 from __future__ import annotations
 
 import json
-import threading
-from typing import Dict
 
-from ..serve.metrics import Counter
+from ..telemetry.registry import REGISTRY, NamedCounters
 
 
-class SuperviseMetrics:
+class SuperviseMetrics(NamedCounters):
     """Named monotone counters for the supervisor's recovery loop."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-
-    def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            c = self._counters.get(name)
-            if c is None:
-                c = self._counters[name] = Counter()
-        c.inc(n)
-
-    def count(self, name: str) -> int:
-        with self._lock:
-            c = self._counters.get(name)
-        return c.snapshot() if c is not None else 0
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {k: c.snapshot() for k, c in self._counters.items()}
 
     def json_line(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
 
-    def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-
 
 METRICS = SuperviseMetrics()
+REGISTRY.register_source("supervisor", METRICS)

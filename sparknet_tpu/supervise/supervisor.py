@@ -51,6 +51,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..telemetry import trace as _trace
 from . import records
 from .metrics import METRICS
 from .policy import (
@@ -353,8 +354,12 @@ class Supervisor:
                 f"{width if self.num_procs > 1 else 1} process(es)"
                 + (f" [{action}]" if action else "")
             )
-            procs = self._spawn(generation, width)
-            exits = self._wait(procs)
+            # one span per generation: a traced supervisor shows the
+            # spawn→exit envelope around the children's own spans
+            with _trace.span("supervisor.generation", cat="supervise",
+                             generation=generation, width=width):
+                procs = self._spawn(generation, width)
+                exits = self._wait(procs)
             duration = time.monotonic() - t0
             classes = {rank: classify_exit(rc) for rank, rc in exits}
             entry: Dict[str, Any] = {

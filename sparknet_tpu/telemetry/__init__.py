@@ -1,0 +1,126 @@
+"""Telemetry — the observability substrate every subsystem reports to.
+
+Four subsystems grew their own counters and their own JSON print lines
+(serve, the data pipeline, chaos, the supervisor); none of them could
+answer the question the source paper is built on: *where does a
+training step's wall time go* — host input, H2D, compiled compute,
+cross-host sync, or snapshot I/O?  This package is the one substrate:
+
+- :mod:`~sparknet_tpu.telemetry.registry` — metric primitives
+  (Counter/Gauge/LatencyHistogram, moved here from ``serve/metrics``)
+  plus the process-global, label-aware :data:`REGISTRY` whose
+  ``snapshot()`` carries every family and every registered subsystem
+  source in one JSON-able tree.
+- :mod:`~sparknet_tpu.telemetry.trace` — a bounded, thread-aware span
+  tracer exporting Chrome trace-event JSON (Perfetto-loadable), with
+  sidecar files from pipeline workers / supervised children merged by
+  pid/tid.  Near-zero cost when disabled.
+- :mod:`~sparknet_tpu.telemetry.timeline` — per-iteration phase
+  attribution in the train loop (input wait, device put, multihost
+  sync, fenced compiled step, eval, snapshot) and the step-time
+  breakdown table — the paper's τ-vs-communication accounting read
+  off the live loop.
+- :mod:`~sparknet_tpu.telemetry.exporter` — Prometheus text rendering
+  (mounted on the serve server's ``GET /metrics``) and the periodic
+  ``telemetry:`` log line (``SPARKNET_TELEMETRY_INTERVAL_S``).
+
+Enable per run with ``--trace OUT.json`` on the apps / ``caffe train``
+(or ``SPARKNET_TRACE=OUT.json``); see docs/OBSERVABILITY.md.
+
+Everything here is stdlib-only: no jax import, so the supervisor and
+forked pipeline workers use it without touching a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from . import exporter, timeline, trace
+from .registry import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    LatencyHistogram,
+    NamedCounters,
+    Registry,
+)
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "LatencyHistogram",
+    "NamedCounters",
+    "Registry",
+    "exporter",
+    "finish_run",
+    "install_for_training",
+    "timeline",
+    "trace",
+]
+
+
+# install_for_training's SPARKNET_TRACE export, remembered so
+# finish_run can restore it (in-process reruns must not inherit a
+# stale trace path)
+_saved_trace_env: Optional[tuple] = None
+
+
+def install_for_training(solver, trace_path: Optional[str] = None):
+    """App-side wiring, shared by the image apps, BertApp and the
+    ``caffe`` CLI: resolve ``--trace``/``SPARKNET_TRACE``, enable the
+    span tracer, and (when tracing or ``SPARKNET_TIMELINE=1``) attach
+    an enabled :class:`~sparknet_tpu.telemetry.timeline.Timeline` to
+    the solver so its step loop attributes phases.  The path is
+    exported to ``SPARKNET_TRACE`` so supervised children and forked
+    workers inherit it (restored by :func:`finish_run`).  Returns the
+    resolved trace path (or None)."""
+    global _saved_trace_env
+    path = trace_path or os.environ.get(trace.TRACE_ENV, "").strip() or None
+    if path:
+        _saved_trace_env = (os.environ.get(trace.TRACE_ENV),)
+        os.environ[trace.TRACE_ENV] = path
+        trace.enable(path)
+    if path or os.environ.get("SPARKNET_TIMELINE", "") not in ("", "0"):
+        solver.timeline = timeline.Timeline()
+        timeline.set_current(solver.timeline)
+    return path
+
+
+@contextlib.contextmanager
+def training_loop(tl, emit=print):
+    """Bracket a training loop: start the timeline's wall clock and the
+    periodic ``telemetry:`` flush (``SPARKNET_TELEMETRY_INTERVAL_S``,
+    default off), stop both on the way out — exception-safe, so a
+    crashed loop still emits its final telemetry line."""
+    stop_flush = exporter.maybe_start_periodic(emit=emit)
+    tl.start()
+    try:
+        yield
+    finally:
+        tl.stop()
+        stop_flush()
+
+
+def finish_run() -> None:
+    """End-of-run hook (apps' ``finally``): write the merged Chrome
+    trace when this process owns one, then reset tracer + current
+    timeline (and the SPARKNET_TRACE export) so an in-process rerun
+    (tests driving ``main()`` twice) starts clean.  Safe to call when
+    telemetry was never enabled."""
+    global _saved_trace_env
+    if trace.enabled():
+        try:
+            trace.write()
+        finally:
+            trace.disable()
+    if _saved_trace_env is not None:
+        prev = _saved_trace_env[0]
+        _saved_trace_env = None
+        if prev is None:
+            os.environ.pop(trace.TRACE_ENV, None)
+        else:
+            os.environ[trace.TRACE_ENV] = prev
+    timeline.set_current(None)

@@ -21,7 +21,11 @@ supervisor (docs/MULTIHOST.md "Recovery": the training run becomes
 child process(es) that are automatically relaunched with
 ``--auto-resume`` under a restart budget, capped backoff and flap
 detection, with machine-readable failure records in the run dir and a
-``supervisor:`` recovery-counter line on exit).
+``supervisor:`` recovery-counter line on exit) and ``--trace=OUT.json``
+/ ``SPARKNET_TRACE`` for the telemetry subsystem (docs/OBSERVABILITY.md:
+the run writes a Perfetto-loadable Chrome trace — pipeline workers and
+supervised children merged in by pid/tid — and prints the per-phase
+step-time breakdown table, the paper's τ-vs-communication accounting).
 ``time`` routes to tools/time_net; ``test`` builds the
 TEST-phase net and reports averaged metrics.  Both ``--flag=value``
 and ``--flag value`` spellings are accepted, like the original binary.

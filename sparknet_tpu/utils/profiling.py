@@ -11,6 +11,11 @@ exposed here:
   meter for app training loops (items = images or tokens).
 - :func:`compiled_flops` — actual per-execution FLOPs of a lowered
   jitted function from XLA cost analysis (the bench.py MFU numerator).
+
+This module answers *op-level* questions (what XLA did inside a
+dispatch).  Host-side observability — metrics registry, span tracing,
+per-step phase attribution, Prometheus export — lives in
+:mod:`sparknet_tpu.telemetry` (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
