@@ -14,6 +14,14 @@ computed against that.
 Env knobs:
   BENCH_MODEL=alexnet|googlenet|resnet50|vgg16|bert
                              model under test (default alexnet)
+  BENCH_MODEL=comm           communication-layer A/B instead: local-SGD
+                             rounds on an 8-way dp mesh (virtual CPU
+                             devices unless BENCH_COMM_NATIVE=1),
+                             monolithic vs bucketed reduction x
+                             none/bf16/int8 compression, with bucket
+                             histogram, bytes-on-wire estimate and the
+                             --tau auto controller trajectory
+  BENCH_MODEL=input_pipeline host preprocessing A/B (PR 2)
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
   BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
@@ -530,6 +538,108 @@ def bench_input_pipeline(platform: str) -> dict:
     }
 
 
+def bench_comm(platform: str) -> dict:
+    """Communication-layer A/B (``BENCH_MODEL=comm``): τ-local-SGD
+    rounds of cifar10_quick on a dp mesh, one arm per comm config.
+
+    Every arm runs the SAME rounds with a fenced telemetry timeline,
+    so the record reads exposed reduction time (``grad_allreduce``) and
+    barrier time (``multihost_sync``) per arm next to round wall time —
+    the ISSUE 6 success metric, machine-readable.  Runs on 8 virtual
+    CPU devices by default (the tunnel exposes one chip; an 8-way A/B
+    needs a mesh) — algorithmic fidelity, byte estimates and the tau
+    trajectory are meaningful there; absolute ms are CPU numbers."""
+    from sparknet_tpu.parallel import CommConfig, ParallelSolver, make_mesh
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.telemetry import timeline as _ttl
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    sp = caffe_pb.load_solver(os.path.join(zoo, "cifar10_quick_solver.prototxt"))
+    ndev = len(jax.devices())
+    bs = int(os.environ.get("BENCH_BATCH", 4 * ndev))
+    tau = int(os.environ.get("BENCH_TAU", 4))
+    rounds = int(os.environ.get("BENCH_ITERS", 6))
+    shapes = {"data": (bs, 32, 32, 3), "label": (bs,)}
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(bs,)), jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    mesh = make_mesh()
+
+    def run_arm(cc, tau_arg):
+        solver = ParallelSolver(
+            sp, shapes, solver_dir=zoo, mesh=mesh, mode="local",
+            tau=tau_arg, comm_config=cc,
+        )
+        solver.step(feed(), 2 * solver.tau)  # compile + warm both programs
+        tl = _ttl.Timeline(fence=True)
+        solver.timeline = tl  # the controller reads it per round too
+        _ttl.set_current(tl)
+        tl.start()
+        m = solver.step(feed(), rounds * solver.tau)
+        _fence(m)
+        tl.stop()
+        ph = tl.phase_seconds()
+        wall = max(tl.wall_s, 1e-9)
+        sync_s = ph.get("grad_allreduce", 0.0) + ph.get("multihost_sync", 0.0)
+        report = solver.comm_report()
+        out = {
+            "round_ms": round(1e3 * wall / rounds, 3),
+            "compiled_step_ms": round(
+                1e3 * ph.get("compiled_step", 0.0) / rounds, 3
+            ),
+            "grad_allreduce_ms": round(
+                1e3 * ph.get("grad_allreduce", 0.0) / rounds, 3
+            ),
+            "sync_share_pct": round(100.0 * sync_s / wall, 2),
+            "loss": round(float(next(iter(m.values()))), 5),
+            "wire_bytes_per_reduction": report["wire_bytes_per_reduction"],
+            "buckets": report["buckets"],
+        }
+        if solver.tau_controller is not None:
+            snap = solver.tau_controller.snapshot()
+            out["tau_trajectory"] = snap["tau_trajectory"]
+            out["tau_decisions"] = [
+                {k: d[k] for k in ("round", "action", "next_tau", "reason")}
+                for d in snap["decisions"]
+            ]
+        return out
+
+    arms = {
+        "monolithic": run_arm(CommConfig(mode="monolithic"), tau),
+        "bucketed_none": run_arm(CommConfig(mode="bucketed"), tau),
+        "bucketed_bf16": run_arm(CommConfig(compress="bf16"), tau),
+        "bucketed_int8": run_arm(CommConfig(compress="int8"), tau),
+        "bucketed_tau_auto": run_arm(CommConfig(compress="bf16"), "auto"),
+    }
+    mono, buck = arms["monolithic"], arms["bucketed_none"]
+    return {
+        "metric": "comm_round_ms_bucketed_vs_monolithic",
+        "value": buck["round_ms"],
+        "unit": "ms/round",
+        "vs_baseline": None,
+        "platform": platform,
+        "devices": ndev,
+        "batch_size": bs,
+        "tau": tau,
+        "rounds": rounds,
+        "round_ms_vs_monolithic": round(
+            buck["round_ms"] / max(mono["round_ms"], 1e-9), 3
+        ),
+        "wire_bytes_bf16_vs_none": round(
+            arms["bucketed_bf16"]["wire_bytes_per_reduction"]
+            / max(buck["wire_bytes_per_reduction"], 1), 3
+        ),
+        "arms": arms,
+    }
+
+
 def bench_bert(platform: str) -> dict:
     from sparknet_tpu.data.text import mlm_dataset, mlm_feed
     from sparknet_tpu.models.bert import BertConfig, BertMLM
@@ -615,11 +725,20 @@ def main() -> None:
     from sparknet_tpu.tools._common import honor_platform_env
 
     honor_platform_env()
-    platform = _first_device().platform
     mode = os.environ.get("BENCH_MODEL", "alexnet")
+    if mode == "comm" and not os.environ.get("BENCH_COMM_NATIVE"):
+        # the comm A/B needs a mesh; the tunnel exposes one chip — run
+        # on 8 virtual CPU devices (same device-forcing recipe as the
+        # driver's dryrun_multichip) BEFORE any backend init
+        from __graft_entry__ import _ensure_devices
+
+        _ensure_devices(8)
+    platform = _first_device().platform
     profile_dir = os.environ.get("BENCH_PROFILE")
     if mode == "bert":
         runner = bench_bert
+    elif mode == "comm":
+        runner = bench_comm
     elif mode == "input_pipeline":
         runner = bench_input_pipeline
     elif mode in IMAGENET_ARCHS:
@@ -666,6 +785,8 @@ if __name__ == "__main__":
                         if bert
                         else "input_pipeline_images_per_sec"
                         if mode == "input_pipeline"
+                        else "comm_round_ms_bucketed_vs_monolithic"
+                        if mode == "comm"
                         else f"{mode}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,

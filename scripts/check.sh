@@ -126,5 +126,57 @@ else
   exit 1
 fi
 
+# ---- comm smoke (ISSUE 6): 5 CPU local-SGD iters on a 2-device virtual
+# mesh with the adaptive-tau controller and bf16-compressed reduction
+# must emit the controller decision log (tau: line + report JSON with
+# decisions), the comm: record line, and a grad_allreduce row in the
+# step-time table — the bucketed reduce running as its own attributed
+# program.
+COMM_DIR=$(mktemp -d /tmp/_comm_smoke.XXXXXX)
+COMM_LOG="$COMM_DIR/smoke.log"
+cat > "$COMM_DIR/net.prototxt" <<'EOF'
+name: "comm_smoke"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+EOF
+cat > "$COMM_DIR/solver.prototxt" <<EOF
+net: "net.prototxt"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 5
+display: 0
+snapshot_prefix: "$COMM_DIR/snap"
+EOF
+if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m sparknet_tpu.tools.caffe train \
+    "--solver=$COMM_DIR/solver.prototxt" --synthetic --synthetic-n=64 \
+    --batch-size=8 --data-workers=0 --native-loader=off \
+    --parallel=local --tau=auto --grad-compress=bf16 \
+    "--trace=$COMM_DIR/trace.json" > "$COMM_LOG" 2>&1 \
+  && grep -q '^tau: {' "$COMM_LOG" \
+  && grep -q '^comm: {' "$COMM_LOG" \
+  && grep -qE "grad_allreduce +[0-9]" "$COMM_LOG" \
+  && python - "$COMM_DIR/snap_tau_controller.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d["decisions"], "empty tau controller decision log"
+for dec in d["decisions"]:
+    assert dec["action"] in ("hold", "widen", "narrow"), dec
+    assert d["tau_min"] <= dec["next_tau"] <= d["tau_max"], dec
+EOF
+then
+  echo "check.sh: comm smoke OK (tau controller log + grad_allreduce attribution)"
+  rm -rf "$COMM_DIR"
+else
+  echo "check.sh: comm SMOKE FAILED — log tail:"
+  tail -20 "$COMM_LOG"
+  exit 1
+fi
+
 echo "check.sh: OK — no new failures ($(printf '%s\n' "$failures" | sed '/^$/d' | wc -l) known)"
 exit 0

@@ -136,11 +136,18 @@ def build(args):
     )
     sp = make_solver_param(args)
     if args.parallel == "none":
+        if getattr(args, "grad_compress", None):
+            raise ValueError(
+                "--grad-compress requires --parallel sync|local"
+            )
         solver = Solver(sp, shapes, model=model, seed=args.seed)
     else:
+        from .cifar_app import comm_config_from
+
         solver = ParallelSolver(
             sp, shapes, model=model, seed=args.seed,
             mesh=make_mesh(), mode=args.parallel, tau=args.tau,
+            comm_config=comm_config_from(args),
         )
     feed = mlm_feed(ds, feed_bs, cfg.vocab_size, max_preds, seed=args.seed)
     return solver, feed, cfg
@@ -344,7 +351,15 @@ def parser() -> argparse.ArgumentParser:
                     help="axis spec for tp/sp/pp/ep, e.g. dp=2,tp=2,sp=2 "
                          "(one size may be -1 = all remaining devices)")
     ap.add_argument("--pp-microbatches", type=int, default=2)
-    ap.add_argument("--tau", type=int, default=10)
+    ap.add_argument("--tau", default="10",
+                    help="local-SGD sync period: an integer or 'auto' "
+                         "(telemetry-driven controller)")
+    ap.add_argument("--grad-compress", choices=("none", "bf16", "int8"),
+                    default=None,
+                    help="compress the gradient/weight-delta all-reduce "
+                         "with error-feedback residuals (also "
+                         "SPARKNET_GRAD_COMPRESS; needs --parallel "
+                         "sync|local; docs/COMMUNICATION.md)")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--attention",
                     choices=("flash", "reference", "ring", "ulysses"),
@@ -462,6 +477,20 @@ def main(argv=None) -> Dict[str, float]:
             print("telemetry: step-time breakdown")
             for line in tl.table().splitlines():
                 print(f"  {line}")
+        # comm/tau record lines, same discipline as cifar_app.train_loop
+        if hasattr(solver, "comm_report"):
+            import json as _json
+
+            report = solver.comm_report()
+            tc = getattr(solver, "tau_controller", None)
+            if tc is not None:
+                report.pop("tau_controller", None)
+                print(f"tau: {tc.json_line()}")
+                if args.snapshot_prefix:
+                    path = tc.write_report(args.snapshot_prefix)
+                    if path:
+                        print(f"tau controller report written to {path}")
+            print(f"comm: {_json.dumps(report)}")
     multihost.stop_heartbeat()  # graceful leave (see cifar_app.main)
     return metrics
 

@@ -258,11 +258,18 @@ def build(args) -> tuple:
     if parallel == "none":
         if nproc > 1:
             raise ValueError("multi-host launch requires --parallel sync|local")
+        if getattr(args, "grad_compress", None):
+            # single-device training has no gradient communication to
+            # compress — reject, per the can't-take-effect policy
+            raise ValueError(
+                "--grad-compress requires --parallel sync|local"
+            )
         solver = Solver(sp, shapes, **kw)
     else:
         solver = ParallelSolver(
             sp, shapes, mesh=make_mesh(), mode=parallel,
-            tau=getattr(args, "tau", 1), **kw
+            tau=getattr(args, "tau", 1),
+            comm_config=comm_config_from(args), **kw
         )
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
@@ -280,6 +287,18 @@ def build(args) -> tuple:
     test_feed = make_feed(test_ds, test_tf, feed_test_bs, seed=args.seed + 1)
     record_loader_meta(solver, train_feed)
     return solver, train_feed, test_feed
+
+
+def comm_config_from(args):
+    """``--grad-compress`` (app flag) + ``SPARKNET_COMM`` /
+    ``SPARKNET_GRAD_COMPRESS`` / ``SPARKNET_COMM_BUCKET_MB`` (env) ->
+    the parallel solver's :class:`CommConfig`.  Shared by all three
+    apps (docs/COMMUNICATION.md)."""
+    from ..parallel import comm
+
+    return comm.resolve_config(
+        compress=getattr(args, "grad_compress", None) or None
+    )
 
 
 def resolve_feed_workers(args, nproc: int) -> int:
@@ -456,6 +475,24 @@ def train_loop(
         f"Optimization Done. {done_iters} iters in {dt:.1f}s "
         f"({done_iters / max(dt, 1e-9):.1f} it/s)"
     )
+    # communication record (ParallelSolver only): one `comm:` JSON line
+    # (bucket plan + wire-byte estimate, same discipline as the chaos:
+    # and supervisor: lines) and, under --tau auto, the controller's
+    # decision log as a `tau:` line + a machine-readable report next to
+    # the snapshots (docs/COMMUNICATION.md)
+    if hasattr(solver, "comm_report"):
+        import json as _json
+
+        report = solver.comm_report()
+        tc = getattr(solver, "tau_controller", None)
+        if tc is not None:
+            report.pop("tau_controller", None)  # the tau: line carries it
+            log(f"tau: {tc.json_line()}")
+            if multihost.is_primary() and sp.snapshot_prefix:
+                path = tc.write_report(sp.snapshot_prefix)
+                if path:
+                    log(f"tau controller report written to {path}")
+        log(f"comm: {_json.dumps(report)}")
     if tl.enabled:
         # the paper's τ-vs-communication accounting, read off the live
         # loop: input wait / H2D / multihost sync / fenced compute /
@@ -495,8 +532,19 @@ def arg_parser() -> argparse.ArgumentParser:
                          "is bit-identical for any count")
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
-    ap.add_argument("--tau", type=int, default=10,
-                    help="local-SGD sync period (the SparkNet τ knob)")
+    ap.add_argument("--tau", default="10",
+                    help="local-SGD sync period (the SparkNet τ knob): "
+                         "an integer, or 'auto' for the telemetry-"
+                         "driven controller — widens when rounds are "
+                         "sync-bound, narrows when the loss diverges "
+                         "between syncs (docs/COMMUNICATION.md)")
+    ap.add_argument("--grad-compress", choices=("none", "bf16", "int8"),
+                    default=None,
+                    help="compress the gradient/weight-delta all-reduce "
+                         "(bf16 cast or int8 with a shared per-bucket "
+                         "scale), with error-feedback residuals carried "
+                         "in opt state (also SPARKNET_GRAD_COMPRESS; "
+                         "requires --parallel sync|local)")
     ap.add_argument("--restore", default=None, metavar="SOLVERSTATE",
                     help="resume from a .solverstate.npz snapshot")
     ap.add_argument("--auto-resume", action="store_true",

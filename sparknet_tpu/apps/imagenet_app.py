@@ -31,6 +31,7 @@ from ..parallel import ParallelSolver, make_mesh, multihost
 from .cifar_app import (
     _batch_size,
     _data_layer,
+    comm_config_from,
     make_native_feed,
     record_loader_meta,
     train_loop,
@@ -192,6 +193,10 @@ def build(args):
     if args.parallel == "none":
         if device_augment:
             kw["batch_transform"] = train_tf.device_fn()
+        if getattr(args, "grad_compress", None):
+            raise ValueError(
+                "--grad-compress requires --parallel sync|local"
+            )
         solver = Solver(sp, shapes, **kw)
     else:
         if device_augment:
@@ -200,7 +205,8 @@ def build(args):
                 "(the parallel solvers build their own train steps)"
             )
         solver = ParallelSolver(
-            sp, shapes, mesh=make_mesh(), mode=args.parallel, tau=args.tau, **kw
+            sp, shapes, mesh=make_mesh(), mode=args.parallel, tau=args.tau,
+            comm_config=comm_config_from(args), **kw
         )
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
@@ -249,8 +255,16 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--batch-size", type=int, default=0)
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
-    ap.add_argument("--tau", type=int, default=10,
-                    help="local-SGD sync period (the SparkNet τ knob)")
+    ap.add_argument("--grad-compress", choices=("none", "bf16", "int8"),
+                    default=None,
+                    help="compress the gradient/weight-delta all-reduce "
+                         "with error-feedback residuals (also "
+                         "SPARKNET_GRAD_COMPRESS; needs --parallel "
+                         "sync|local; docs/COMMUNICATION.md)")
+    ap.add_argument("--tau", default="10",
+                    help="local-SGD sync period (the SparkNet τ knob): "
+                         "an integer or 'auto' (telemetry-driven "
+                         "controller)")
     ap.add_argument("--device-augment", action="store_true",
                     help="apply crop/mirror/mean on device inside the "
                          "jitted step (host ships uint8 + the aug plan); "

@@ -189,6 +189,18 @@ class PipelineMetrics:
                 "consumer_wait": self.consumer_wait.snapshot(),
                 "reorder_depth": self.reorder_depth.snapshot(),
                 "slots_free": self.slots_free.snapshot(),
+                # host copies the local-SGD round staging saved by
+                # reusing its preallocated buffers (parallel/local_sgd
+                # RoundBuffer) — surfaced here so the one input-
+                # pipeline line answers the whole host-copy story
+                "round_buffer": {
+                    "reuses": REGISTRY.counter(
+                        "round_buffer", event="reuse"
+                    ).snapshot(),
+                    "allocs": REGISTRY.counter(
+                        "round_buffer", event="alloc"
+                    ).snapshot(),
+                },
             }
 
     def json_line(self) -> str:

@@ -13,31 +13,48 @@ from .mesh import (
     replicated,
     shard_batch,
 )
-from .data_parallel import make_dp_eval_step, make_dp_train_step
+from .comm import CommConfig, resolve_config
+from .data_parallel import (
+    make_bucketed_dp_train_step,
+    make_dp_eval_step,
+    make_dp_train_step,
+)
 from .local_sgd import (
+    RoundBuffer,
     init_local_opt_state,
+    make_local_scan,
     make_local_sgd_round,
+    make_round_reduce,
     round_batch_sharding,
     stack_round_batches,
 )
+from .tau_controller import TauController
 from .trainer import ParallelSolver
-from . import multihost
+from . import comm, multihost
 
 __all__ = [
+    "comm",
     "multihost",
     "DP_AXIS",
     "PP_AXIS",
     "SP_AXIS",
     "TP_AXIS",
+    "CommConfig",
     "ParallelSolver",
+    "RoundBuffer",
+    "TauController",
     "batch_sharding",
     "init_local_opt_state",
+    "make_bucketed_dp_train_step",
     "make_dp_eval_step",
     "make_dp_train_step",
+    "make_local_scan",
     "make_local_sgd_round",
+    "make_round_reduce",
     "make_mesh",
     "replicate",
     "replicated",
+    "resolve_config",
     "round_batch_sharding",
     "shard_batch",
     "stack_round_batches",

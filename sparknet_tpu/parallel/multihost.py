@@ -484,12 +484,22 @@ def put_global(batch: Any, sharding: jax.sharding.NamedSharding) -> Any:
     arrive before the global array exists), so the active timeline
     attributes it as ``multihost_sync`` — nested inside the solver's
     ``device_put`` phase, which then reports only its exclusive H2D
-    time — and the tracer records a span per call."""
+    time — and the tracer records a span per call.  The comm layer's
+    byte accounting (``comm_bytes{path=host_assembly}``) counts this
+    process's contribution, so the registry answers "barrier wait vs
+    bytes moved" next to the ``grad_allreduce`` estimates."""
     with _trace.span("multihost.put_global", cat="multihost"), \
             _timeline.current_phase("multihost_sync"):
-        return jax.tree_util.tree_map(
-            lambda x: jax.make_array_from_process_local_data(
-                sharding, np.asarray(x)
-            ),
-            batch,
-        )
+        nbytes = 0
+
+        def assemble(x):
+            nonlocal nbytes
+            x = np.asarray(x)
+            nbytes += x.nbytes
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        out = jax.tree_util.tree_map(assemble, batch)
+        from ..telemetry import REGISTRY
+
+        REGISTRY.counter("comm_bytes", path="host_assembly").inc(nbytes)
+        return out

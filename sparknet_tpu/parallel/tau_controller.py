@@ -1,0 +1,201 @@
+"""Adaptive τ for local SGD — the paper's knob, closed-loop.
+
+SparkNet (PAPER.md) leaves τ a hand-set constant and derives the
+tradeoff analytically: more local steps amortize communication but add
+staleness.  PR 5's telemetry made both sides of that tradeoff
+measurable per round — the ``multihost_sync``/``grad_allreduce`` share
+of step time on one side, the loss trajectory on the other — so τ can
+be a control loop instead of a guess (``--tau auto`` on the apps):
+
+- **Widen** (τ ← 2τ, up to ``tau_max``) when the round is *sync-bound*:
+  the communication phases exceed ``widen_share`` of round wall time.
+  More local steps per sync directly shrink that share.
+- **Narrow** (τ ← τ/2, down to ``tau_min``) when the loss *diverges*
+  between sync points: a round's τ-mean loss rising more than
+  ``narrow_divergence`` above its smoothed trajectory means staleness
+  is eating the communication win — sync more often.
+
+τ moves by doubling/halving only, so a run compiles at most
+``log2(tau_max/tau_min)`` distinct round programs (the round fns are
+cached per τ).  Every decision lands in the telemetry registry
+(``tau_controller`` gauges) and the decision log, which the apps write
+as a machine-readable run record next to the snapshots
+(``<prefix>_tau_controller.json``, same discipline as
+``supervisor_report.json``).  Unit-testable from synthetic telemetry
+snapshots — no mesh required (tests/test_comm.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+
+class TauController:
+    """Host-side τ control loop for :class:`ParallelSolver` local mode.
+
+    Call :meth:`observe_round` once per completed round with that
+    round's wall seconds, its communication-phase seconds (exposed
+    ``grad_allreduce`` + ``multihost_sync``, from the timeline), and
+    the round's mean loss; it returns the τ to use for the NEXT round.
+    """
+
+    def __init__(
+        self,
+        tau: int = 8,
+        tau_min: Optional[int] = None,
+        tau_max: Optional[int] = None,
+        widen_share: float = 0.25,
+        narrow_divergence: float = 0.10,
+        loss_smoothing: float = 0.7,
+        cooldown_rounds: int = 2,
+    ):
+        self.tau_min = tau_min if tau_min is not None else _env_int(
+            "SPARKNET_TAU_MIN", 1
+        )
+        self.tau_max = tau_max if tau_max is not None else _env_int(
+            "SPARKNET_TAU_MAX", 64
+        )
+        if not (1 <= self.tau_min <= self.tau_max):
+            raise ValueError(
+                f"need 1 <= tau_min <= tau_max, got "
+                f"[{self.tau_min}, {self.tau_max}]"
+            )
+        self.tau = int(min(max(tau, self.tau_min), self.tau_max))
+        self.widen_share = widen_share
+        self.narrow_divergence = narrow_divergence
+        self.loss_smoothing = loss_smoothing
+        # decisions need a few rounds of signal at the NEW tau before
+        # moving again — without a cooldown one noisy loss round can
+        # saw the controller between two values forever
+        self.cooldown_rounds = max(0, cooldown_rounds)
+        self._cooldown = 0
+        self._loss_ema: Optional[float] = None
+        self._round = 0
+        self.decisions: List[Dict[str, Any]] = []
+        from ..telemetry import REGISTRY
+
+        self._g_tau = REGISTRY.gauge("tau_controller", signal="tau")
+        self._g_share = REGISTRY.gauge(
+            "tau_controller", signal="sync_share_pct"
+        )
+        self._g_div = REGISTRY.gauge(
+            "tau_controller", signal="divergence_pct"
+        )
+        self._g_tau.set(self.tau)
+
+    # ------------------------------------------------------------------
+    def observe_round(
+        self, *, round_s: float, sync_s: float, loss: float
+    ) -> int:
+        """Digest one round's telemetry; returns the next round's τ."""
+        self._round += 1
+        share = (sync_s / round_s) if round_s > 0 else 0.0
+        if self._loss_ema is None:
+            self._loss_ema = loss
+        divergence = (
+            (loss - self._loss_ema) / max(abs(self._loss_ema), 1e-12)
+        )
+        prev_tau, action, why = self.tau, "hold", ""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            why = "cooldown"
+        elif divergence > self.narrow_divergence and self.tau > self.tau_min:
+            # staleness is winning: halve toward fresher syncs
+            self.tau = max(self.tau_min, self.tau // 2)
+            action, why = "narrow", (
+                f"divergence {divergence:.1%} > {self.narrow_divergence:.0%}"
+            )
+            self._cooldown = self.cooldown_rounds
+        elif share > self.widen_share and self.tau < self.tau_max:
+            # sync-bound: double the local work each round amortizes
+            self.tau = min(self.tau_max, self.tau * 2)
+            action, why = "widen", (
+                f"sync share {share:.1%} > {self.widen_share:.0%}"
+            )
+            self._cooldown = self.cooldown_rounds
+        # EMA after the divergence test: the test compares THIS round
+        # against the trajectory before it
+        self._loss_ema = (
+            self.loss_smoothing * self._loss_ema
+            + (1.0 - self.loss_smoothing) * loss
+        )
+        self._g_tau.set(self.tau)
+        self._g_share.set(round(100.0 * share, 2))
+        self._g_div.set(round(100.0 * divergence, 2))
+        self.decisions.append(
+            {
+                "round": self._round,
+                "tau": prev_tau,
+                "next_tau": self.tau,
+                "action": action,
+                "reason": why,
+                "sync_share": round(share, 4),
+                "divergence": round(divergence, 4),
+                "round_s": round(round_s, 5),
+                "sync_s": round(sync_s, 5),
+                "loss": round(float(loss), 6),
+            }
+        )
+        return self.tau
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The machine-readable run record (the ``tau:`` log line and
+        ``<prefix>_tau_controller.json``)."""
+        taus = [d["next_tau"] for d in self.decisions]
+        return {
+            "tau": self.tau,
+            "tau_min": self.tau_min,
+            "tau_max": self.tau_max,
+            "rounds": self._round,
+            "widened": sum(1 for d in self.decisions if d["action"] == "widen"),
+            "narrowed": sum(
+                1 for d in self.decisions if d["action"] == "narrow"
+            ),
+            "tau_trajectory": taus,
+            "decisions": self.decisions,
+        }
+
+    def json_line(self) -> str:
+        return json.dumps(self.snapshot())
+
+    def write_report(self, snapshot_prefix: str) -> Optional[str]:
+        """Persist the decision record next to the run's snapshots
+        (``<prefix>_tau_controller.json``); returns the path, or None
+        when there is no prefix to anchor it to."""
+        if not snapshot_prefix:
+            return None
+        path = f"{snapshot_prefix}_tau_controller.json"
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+
+def parse_tau(value) -> tuple:
+    """App-side ``--tau`` parsing: an int, or ``auto`` for the
+    controller.  Returns ``(tau_int_or_initial, auto: bool)``."""
+    if isinstance(value, int):
+        return value, False
+    s = str(value).strip().lower()
+    if s == "auto":
+        return _env_int("SPARKNET_TAU_INITIAL", 8), True
+    try:
+        return int(s), False
+    except ValueError:
+        raise ValueError(
+            f"--tau must be an integer or 'auto', got {value!r}"
+        ) from None

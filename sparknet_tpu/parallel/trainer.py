@@ -11,11 +11,19 @@ collective.  Two modes:
   default on a TPU pod where ICI makes sync cheap).
 - ``mode="local"`` — SparkNet's τ-local SGD: each mesh ``dp`` slice
   runs τ independent steps, then weights are averaged.  The τ knob
-  reproduces the paper's communication/staleness tradeoff.
+  reproduces the paper's communication/staleness tradeoff — and with
+  ``tau="auto"`` becomes a telemetry-driven control loop
+  (:mod:`.tau_controller`).
+
+Communication in both modes routes through :mod:`.comm` (bucketed
+reduction, optional bf16/int8 compression with error-feedback
+residuals in opt state); ``SPARKNET_COMM=monolithic`` restores the
+pre-bucketing fused all-reduce as the A/B baseline.
 """
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -23,14 +31,21 @@ import jax.numpy as jnp
 
 from ..proto import caffe_pb
 from ..solver.trainer import Solver
+from . import comm as comm_mod
 from .data_parallel import make_dp_eval_step, make_dp_train_step
 from .local_sgd import (
+    RESIDUAL_KEY,
+    RoundBuffer,
     init_local_opt_state,
+    init_local_residual,
+    make_local_scan,
     make_local_sgd_round,
+    make_round_reduce,
     round_batch_sharding,
     stack_round_batches,
 )
 from .mesh import DP_AXIS, batch_sharding, make_mesh, replicate
+from .tau_controller import TauController, parse_tau
 from . import multihost
 
 
@@ -42,8 +57,9 @@ class ParallelSolver(Solver):
         *,
         mesh: Optional[jax.sharding.Mesh] = None,
         mode: str = "sync",
-        tau: int = 1,
+        tau=1,
         dp_axis: str = DP_AXIS,
+        comm_config: Optional[comm_mod.CommConfig] = None,
         **kw: Any,
     ):
         if kw.get("batch_transform") is not None:
@@ -57,7 +73,25 @@ class ParallelSolver(Solver):
         super().__init__(solver, input_shapes, **kw)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
-        self.tau = int(tau)
+        self.comm = (
+            comm_config if comm_config is not None
+            else comm_mod.resolve_config()
+        )
+        # recorded into the solverstate (Solver.save env_meta): resuming
+        # under a different wire format warns through the existing
+        # env-drift machinery, on top of the residual reconciliation
+        self.env_meta["grad_compress"] = self.comm.compress
+        tau0, tau_auto = parse_tau(tau)
+        self.tau = int(tau0)
+        self.tau_controller: Optional[TauController] = None
+        if tau_auto:
+            if mode == "sync":
+                raise ValueError(
+                    "tau='auto' drives local-SGD round length — it "
+                    "needs mode='local' (--parallel local)"
+                )
+            self.tau_controller = TauController(tau=self.tau)
+            self.tau = self.tau_controller.tau
         if mode != "sync" and self.tau > 1:
             # local-SGD materialises only per-round tau-means, so the
             # display window is in ROUNDS: ceil(average_loss / tau)
@@ -90,31 +124,61 @@ class ParallelSolver(Solver):
             self._train_sharding = self._eval_sharding
         if mode == "sync":
             self.opt_state = replicate(self.opt_state, self.mesh)
+            if self.comm.for_sync() == "bucketed" and self.comm.wants_residual:
+                self.opt_state[RESIDUAL_KEY] = jax.device_put(
+                    init_local_residual(self.params, ndp),
+                    self._dp_sharding(),
+                )
             self._train_step = make_dp_train_step(
-                self.train_net, solver, self.mesh, dp_axis
+                self.train_net, solver, self.mesh, dp_axis,
+                config=self.comm,
             )
             self._eval_step = make_dp_eval_step(self.test_net, self.mesh, dp_axis)
+            comm_mod.count_reduction(self.comm, self.params, "sync_grads")
         elif mode == "local":
             if self.tau < 1:
                 raise ValueError(f"tau must be >= 1, got {self.tau}")
-            self.opt_state = jax.device_put(
-                init_local_opt_state(solver, self.params, ndp),
-                jax.sharding.NamedSharding(
-                    self.mesh, jax.sharding.PartitionSpec(dp_axis)
-                ),
-            )
+            opt_state = init_local_opt_state(solver, self.params, ndp)
+            if (
+                self.comm.for_local() == "bucketed"
+                and self.comm.wants_residual
+            ):
+                opt_state[RESIDUAL_KEY] = init_local_residual(
+                    self.params, ndp
+                )
+            self.opt_state = jax.device_put(opt_state, self._dp_sharding())
             # round fns keyed by effective tau: the last round of a
             # step(n) with n % tau != 0 runs a shorter compiled round
-            # rather than overshooting n.
+            # rather than overshooting n.  Bucketed rounds split into a
+            # per-tau scan and ONE tau-independent reduce program.
             self._rounds: Dict[int, Any] = {}
+            self._reduce_fn = (
+                make_round_reduce(self.mesh, self.comm, dp_axis)
+                if self.comm.for_local() == "bucketed" else None
+            )
+            self._round_buffer = RoundBuffer()
             self._batch_sharding = round_batch_sharding(
                 self.mesh, dp_axis, solver.iter_size
             )
             self._eval_step = make_dp_eval_step(self.test_net, self.mesh, dp_axis)
+            comm_mod.count_reduction(self.comm, self.params, "round_average")
         else:
             raise ValueError(f"mode {mode!r} (want 'sync' or 'local')")
+        if self.tau_controller is not None and not self.timeline.enabled:
+            # the controller's widen signal IS the timeline's sync share
+            # — auto-tau implies attribution even without --trace
+            from ..telemetry import timeline as _ttl
+
+            self.timeline = _ttl.Timeline(fence=True)
+            _ttl.set_current(self.timeline)
+            self.timeline.start()
 
     # ------------------------------------------------------------------
+    def _dp_sharding(self):
+        return jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
+        )
+
     def scan_steps(self, batch, n: int):
         """Not supported: the base implementation scans the
         SINGLE-DEVICE train step, which would silently bypass this
@@ -136,18 +200,60 @@ class ParallelSolver(Solver):
         sharding = self._train_sharding if train else self._eval_sharding
         return multihost.put_global(batch, sharding)
 
+    def _wants_residual(self) -> bool:
+        active = (
+            self.comm.for_local() if self.mode == "local"
+            else self.comm.for_sync()
+        )
+        return active == "bucketed" and self.comm.wants_residual
+
+    def _reconcile_residual(self, opt_state):
+        """Snapshot <-> config drift: a pre-comm (or --grad-compress
+        none) snapshot restored into a lossy run gets fresh zero
+        residuals; a lossy snapshot restored into a lossless run drops
+        them.  Either way the restore proceeds with a warning instead
+        of a KeyError deep inside the compiled step."""
+        wants, has = self._wants_residual(), RESIDUAL_KEY in opt_state
+        if wants and not has:
+            if jax.process_index() == 0:
+                print(
+                    "WARNING: snapshot carries no error-feedback "
+                    "residuals (taken without --grad-compress?) — "
+                    "starting compression from zero residuals",
+                    file=sys.stderr, flush=True,
+                )
+            ndp = self.mesh.shape[self.dp_axis]
+            opt_state = dict(opt_state)
+            opt_state[RESIDUAL_KEY] = init_local_residual(self.params, ndp)
+        elif has and not wants:
+            if jax.process_index() == 0:
+                print(
+                    "WARNING: dropping the snapshot's error-feedback "
+                    "residuals (--grad-compress is off in this run)",
+                    file=sys.stderr, flush=True,
+                )
+            opt_state = {
+                k: v for k, v in opt_state.items() if k != RESIDUAL_KEY
+            }
+        return opt_state
+
     def _place_restored(self, params, state, opt_state):
         params = replicate(params, self.mesh)
         state = replicate(state, self.mesh)
+        if opt_state:
+            opt_state = self._reconcile_residual(opt_state)
         if self.mode == "sync":
+            resid = None
+            if RESIDUAL_KEY in opt_state:
+                opt_state = dict(opt_state)
+                resid = opt_state.pop(RESIDUAL_KEY)
             opt_state = replicate(opt_state, self.mesh)
+            if resid is not None:
+                opt_state[RESIDUAL_KEY] = jax.device_put(
+                    resid, self._dp_sharding()
+                )
         else:  # local: per-dp-slice optimizer slots, sharded on dp
-            opt_state = jax.device_put(
-                opt_state,
-                jax.sharding.NamedSharding(
-                    self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
-                ),
-            )
+            opt_state = jax.device_put(opt_state, self._dp_sharding())
         return params, state, opt_state
 
     def _reinit_opt_state(self):
@@ -157,31 +263,79 @@ class ParallelSolver(Solver):
         solver's layout instead."""
         from ..solver.caffe_solver import init_opt_state
 
-        if self.mode == "sync":
-            return replicate(init_opt_state(self.sp, self.params), self.mesh)
         ndp = self.mesh.shape[self.dp_axis]
-        return jax.device_put(
-            init_local_opt_state(self.sp, self.params, ndp),
-            jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
-            ),
-        )
+        if self.mode == "sync":
+            opt = replicate(init_opt_state(self.sp, self.params), self.mesh)
+            if self._wants_residual():
+                opt[RESIDUAL_KEY] = jax.device_put(
+                    init_local_residual(self.params, ndp),
+                    self._dp_sharding(),
+                )
+            return opt
+        opt = init_local_opt_state(self.sp, self.params, ndp)
+        if self._wants_residual():
+            opt[RESIDUAL_KEY] = init_local_residual(self.params, ndp)
+        return jax.device_put(opt, self._dp_sharding())
 
     def _round_fn(self, tau: int):
+        """Per-tau compiled round program: the monolithic one-dispatch
+        round, or (bucketed) the scan half of the two-program round."""
         if tau not in self._rounds:
-            self._rounds[tau] = make_local_sgd_round(
-                self.train_net, self.sp, self.mesh, tau, self.dp_axis
-            )
+            if self._reduce_fn is not None:
+                self._rounds[tau] = make_local_scan(
+                    self.train_net, self.sp, self.mesh, tau, self.dp_axis
+                )
+            else:
+                self._rounds[tau] = make_local_sgd_round(
+                    self.train_net, self.sp, self.mesh, tau, self.dp_axis
+                )
         return self._rounds[tau]
 
     def _next_iteration_batch(self, batches):
         """One iteration's worth of host batches (iter_size micro-batches
         stacked on a leading axis when accumulating, Caffe-style)."""
         if self.sp.iter_size > 1:
+            # NO round buffer here: the tau outer stacks copy these
+            # inner stacks only at round end, so inner reuse within a
+            # round (tau > buffer depth) would alias live data
             return stack_round_batches(
                 [next(batches) for _ in range(self.sp.iter_size)]
             )
         return next(batches)
+
+    def _split_residual(self, opt_state):
+        if RESIDUAL_KEY not in opt_state:
+            return opt_state, {}
+        return (
+            {k: v for k, v in opt_state.items() if k != RESIDUAL_KEY},
+            opt_state[RESIDUAL_KEY],
+        )
+
+    def comm_report(self) -> Dict[str, Any]:
+        """Machine-readable communication record for bench records and
+        run reports: the active config, the bucket plan over THIS
+        model's params, and the tau controller's decision log when one
+        is driving."""
+        leaves = jax.tree_util.tree_leaves(self.params)
+        plan = comm_mod.plan_buckets(leaves, self.comm.bucket_bytes)
+        mode = (
+            self.comm.for_local() if self.mode == "local"
+            else self.comm.for_sync()
+        )
+        out = {
+            "mode": mode,
+            "compress": self.comm.compress,
+            "bucket_mb": self.comm.bucket_mb,
+            "buckets": comm_mod.bucket_histogram(plan, leaves),
+            "wire_bytes_per_reduction": comm_mod.wire_bytes(
+                plan if mode == "bucketed"
+                else ((tuple(range(len(leaves))),) if leaves else ()),
+                leaves, self.comm.compress,
+            ),
+        }
+        if self.tau_controller is not None:
+            out["tau_controller"] = self.tau_controller.snapshot()
+        return out
 
     def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
         if self.mode == "sync":
@@ -189,17 +343,19 @@ class ParallelSolver(Solver):
         metrics: Dict[str, Any] = {}
         end = self.iter + n
         tl = self.timeline  # same phase brackets as Solver.step: one
-        # local-SGD round = tau iterations in one compiled dispatch, so
-        # compiled_step here covers the whole round incl. the τ-sync
-        # weight average (the on-device communication the paper's τ
-        # analysis amortizes); put_global attributes multihost_sync
+        # local-SGD round = tau iterations in one compiled dispatch;
+        # bucketed comm adds the round-end reduce as its own dispatch,
+        # bracketed grad_allreduce so the EXPOSED reduction time reads
+        # off the table separately from multihost_sync's barrier time
+        controller = self.tau_controller
         while self.iter < end:
             if self.stop_requested:
                 break
             tau = min(self.tau, end - self.iter)
             with tl.phase("input_wait"):
                 stacked = stack_round_batches(
-                    [self._next_iteration_batch(batches) for _ in range(tau)]
+                    [self._next_iteration_batch(batches) for _ in range(tau)],
+                    buffer=self._round_buffer,
                 )
             with tl.phase("device_put"):
                 if self._multihost:
@@ -208,22 +364,61 @@ class ParallelSolver(Solver):
                     )
                 else:
                     stacked = jax.device_put(stacked, self._batch_sharding)
-            with tl.phase("compiled_step"):
-                self.rng, step_rng = jax.random.split(self.rng)
-                prev = self.iter
-                self.params, self.state, self.opt_state, metrics = (
-                    self._round_fn(tau)(
-                        self.params,
-                        self.state,
-                        self.opt_state,
-                        stacked,
-                        jnp.asarray(self.iter, jnp.int32),
-                        step_rng,
+            phases0 = tl.phase_seconds() if controller is not None else None
+            wall0 = tl.wall_s if controller is not None else 0.0
+            self.rng, step_rng = jax.random.split(self.rng)
+            prev = self.iter
+            it_arr = jnp.asarray(self.iter, jnp.int32)
+            if self._reduce_fn is not None:
+                opt_solver, resid = self._split_residual(self.opt_state)
+                with tl.phase("compiled_step"):
+                    p_start, p_stack, st_stack, opt_out, metrics = (
+                        self._round_fn(tau)(
+                            self.params, self.state, opt_solver,
+                            stacked, it_arr, step_rng,
+                        )
                     )
+                    if tl.fence:
+                        jax.block_until_ready(metrics)
+                with tl.phase("grad_allreduce"):
+                    self.params, self.state, resid = self._reduce_fn(
+                        p_start, p_stack, st_stack, resid
+                    )
+                    if tl.fence:
+                        jax.block_until_ready(self.params)
+                self.opt_state = (
+                    {**opt_out, RESIDUAL_KEY: resid}
+                    if self._wants_residual() else opt_out
                 )
-                if tl.fence:
-                    jax.block_until_ready(metrics)
+            else:
+                with tl.phase("compiled_step"):
+                    self.params, self.state, self.opt_state, metrics = (
+                        self._round_fn(tau)(
+                            self.params,
+                            self.state,
+                            self.opt_state,
+                            stacked,
+                            it_arr,
+                            step_rng,
+                        )
+                    )
+                    if tl.fence:
+                        jax.block_until_ready(metrics)
             self.iter += tau
+            if controller is not None:
+                # host sync per round — the controller's price, only
+                # paid under --tau auto (the loss is about to be fetched
+                # for display smoothing anyway on display rounds)
+                phases1 = tl.phase_seconds()
+                sync_s = sum(
+                    phases1.get(k, 0.0) - (phases0 or {}).get(k, 0.0)
+                    for k in ("grad_allreduce", "multihost_sync")
+                )
+                self.tau = controller.observe_round(
+                    round_s=max(tl.wall_s - wall0, 1e-9),
+                    sync_s=sync_s,
+                    loss=float(metrics.get("loss", 0.0)),
+                )
             d = self.sp.display
             if log_fn and d:
                 # round metrics are already tau-means; the window holds

@@ -50,6 +50,23 @@ def learning_rate(sp: SolverParameter, it: jax.Array) -> jax.Array:
     return lr
 
 
+def opt_state_keys(sp: SolverParameter) -> Tuple[str, ...]:
+    """The slot names :func:`init_opt_state` will create for this
+    solver type — WITHOUT building params.  The comm layer uses this to
+    assign per-key shardings (solver slots replicated, the
+    error-feedback residual per-worker) before any tree exists."""
+    t = sp.solver_type.upper()
+    if t in ("SGD", "NESTEROV"):
+        return ("momentum",)
+    if t in ("ADAM", "ADAMW"):
+        return ("m", "v")
+    if t in ("ADAGRAD", "RMSPROP"):
+        return ("h",)
+    if t == "ADADELTA":
+        return ("h", "d")
+    raise NotImplementedError(f"solver type {sp.solver_type!r}")
+
+
 def init_opt_state(sp: SolverParameter, params: Any) -> Dict[str, Any]:
     zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
     t = sp.solver_type.upper()

@@ -14,6 +14,11 @@ solver and the apps bracket each phase boundary —
 - ``compiled_step``  the jitted train step, *fenced* with
                      ``block_until_ready`` so async dispatch cannot
                      smear compute into the next phase
+- ``grad_allreduce`` the exposed (blocking) time of the bucketed
+                     round-end reduction program (parallel/comm.py) —
+                     distinguishable from ``multihost_sync``'s barrier
+                     wait, so "waiting for peers" and "moving bytes"
+                     read as separate rows
 - ``eval``           TEST-phase evaluation
 - ``snapshot``       solverstate/weights writes
 
@@ -46,6 +51,7 @@ PHASES = (
     "device_put",
     "multihost_sync",
     "compiled_step",
+    "grad_allreduce",
     "eval",
     "snapshot",
 )
@@ -80,6 +86,9 @@ class NullTimeline:
         pass
 
     def snapshot(self) -> dict:
+        return {}
+
+    def phase_seconds(self) -> Dict[str, float]:
         return {}
 
     def table(self) -> str:
@@ -187,6 +196,13 @@ class Timeline:
 
     def attributed_s(self) -> float:
         return sum(t for _, t, _ in self._rows())
+
+    def phase_seconds(self) -> Dict[str, float]:
+        """Cumulative exclusive seconds per phase — the tau
+        controller's per-round signal is the delta between two of
+        these."""
+        with self._lock:
+            return {k: v[0] for k, v in self._totals.items()}
 
     def snapshot(self) -> dict:
         wall = self.wall_s

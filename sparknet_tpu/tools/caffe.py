@@ -29,6 +29,13 @@ step-time breakdown table, the paper's τ-vs-communication accounting).
 ``time`` routes to tools/time_net; ``test`` builds the
 TEST-phase net and reports averaged metrics.  Both ``--flag=value``
 and ``--flag value`` spellings are accepted, like the original binary.
+
+Communication knobs pass through too (docs/COMMUNICATION.md):
+``--parallel local --tau auto`` runs the telemetry-driven τ controller
+(decision log on the ``tau:`` line + ``<prefix>_tau_controller.json``),
+``--grad-compress bf16|int8`` compresses the round-end reduction with
+error-feedback residuals, and the run prints one ``comm:`` JSON line
+(bucket plan + wire-byte estimate).
 """
 
 from __future__ import annotations
