@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Compare two bench records — the first reader of the BENCH_* trail.
+
+PR 5 started embedding a telemetry block (registry + step-phase
+breakdown) in every bench record and PR 6 added wire-byte estimates;
+until now nothing read them back.  This tool diffs two records and
+prints a regression table:
+
+    python scripts/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_diff.py old.json new.json --informational
+
+Rows: headline throughput, step time, each step-phase's share of
+attributed time, and the wire-bytes-per-reduction estimate when a comm
+sub-record exists.  Thresholds (tunable by flag) mark a row REGRESSED;
+the exit code is 1 when anything regressed unless ``--informational``
+(the scripts/check.sh invocation) — so the same tool serves both a CI
+trip-wire and a human diff.
+
+Accepts either shape on disk: a raw ``bench.py`` output record, or the
+driver wrapper ``{"parsed": {...}}`` the repo's BENCH_r*.json use.
+Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise SystemExit(f"{path}: not a JSON object")
+    # driver wrapper: the bench line lives under "parsed"
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return doc["parsed"]
+    return doc
+
+
+def phase_shares(rec: Dict[str, Any]) -> Dict[str, float]:
+    """Phase -> share of attributed time, from the embedded telemetry
+    timeline ({} when the record predates PR 5)."""
+    tl = (rec.get("telemetry") or {}).get("timeline") or {}
+    phases = tl.get("phases") or {}
+    total = sum(p.get("total_s", 0.0) for p in phases.values())
+    if total <= 0:
+        return {}
+    return {
+        name: p.get("total_s", 0.0) / total for name, p in phases.items()
+    }
+
+
+def find_key(obj: Any, key: str) -> Optional[float]:
+    """First numeric value under ``key`` anywhere in the record (the
+    comm sub-record's location varies by BENCH_MODEL)."""
+    if isinstance(obj, dict):
+        if key in obj and isinstance(obj[key], (int, float)):
+            return float(obj[key])
+        for v in obj.values():
+            got = find_key(v, key)
+            if got is not None:
+                return got
+    elif isinstance(obj, list):
+        for v in obj:
+            got = find_key(v, key)
+            if got is not None:
+                return got
+    return None
+
+
+def _fmt(v: Optional[float], unit: str = "") -> str:
+    if v is None:
+        return "—"
+    if unit == "%":
+        return f"{100 * v:.1f}%"
+    if unit == "B":
+        return f"{v:,.0f}"
+    return f"{v:.2f}"
+
+
+def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
+    rows = []  # (name, old, new, unit, regressed, note)
+
+    def add(name, a, b, unit, regressed, note=""):
+        rows.append((name, a, b, unit, regressed, note))
+
+    # headline throughput: higher is better
+    a, b = old.get("value"), new.get("value")
+    if a and b:
+        drop = (a - b) / a
+        add(
+            old.get("metric", "throughput"), a, b, "",
+            drop > args.throughput_pct / 100.0,
+            f"{-drop:+.1%}",
+        )
+    # step time: lower is better
+    a, b = old.get("step_ms"), new.get("step_ms")
+    if a and b:
+        rise = (b - a) / a
+        add("step_ms", a, b, "", rise > args.throughput_pct / 100.0,
+            f"{rise:+.1%}")
+    # phase shares: a share that grew by more than N percentage points
+    ps_old, ps_new = phase_shares(old), phase_shares(new)
+    for name in sorted(set(ps_old) | set(ps_new)):
+        a, b = ps_old.get(name), ps_new.get(name)
+        grew = (
+            a is not None and b is not None
+            and (b - a) * 100.0 > args.phase_pp
+        )
+        note = f"{(b or 0) - (a or 0):+.1%}" if a is not None and b is not None else "new" if a is None else "gone"
+        add(f"phase:{name}", a, b, "%", grew, note)
+    # wire bytes per reduction (comm records): more bytes = regression
+    a = find_key(old, "wire_bytes_per_reduction")
+    b = find_key(new, "wire_bytes_per_reduction")
+    if a and b:
+        rise = (b - a) / a
+        add("wire_bytes_per_reduction", a, b, "B",
+            rise > args.wire_pct / 100.0, f"{rise:+.1%}")
+
+    if not rows:
+        print("bench_diff: no comparable fields between the two records")
+        return 0
+    w = max(len(r[0]) for r in rows)
+    print(f"{'field':<{w}} {'old':>14} {'new':>14} {'delta':>8}  verdict")
+    regressed = 0
+    for name, a, b, unit, bad, note in rows:
+        verdict = "REGRESSED" if bad else "ok"
+        regressed += bad
+        print(
+            f"{name:<{w}} {_fmt(a, unit):>14} {_fmt(b, unit):>14} "
+            f"{note:>8}  {verdict}"
+        )
+    print(
+        f"bench_diff: {regressed} regressed row(s) "
+        f"(thresholds: throughput {args.throughput_pct}%, "
+        f"phase +{args.phase_pp}pp, wire {args.wire_pct}%)"
+    )
+    return 1 if regressed and not args.informational else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_*.json records with thresholds"
+    )
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--throughput-pct", type=float, default=10.0,
+                    help="max tolerated throughput drop / step-time "
+                         "rise, percent (default 10)")
+    ap.add_argument("--phase-pp", type=float, default=10.0,
+                    help="max tolerated phase-share growth, percentage "
+                         "points (default 10)")
+    ap.add_argument("--wire-pct", type=float, default=25.0,
+                    help="max tolerated wire-bytes growth, percent "
+                         "(default 25)")
+    ap.add_argument("--informational", action="store_true",
+                    help="print the table but always exit 0 (the "
+                         "check.sh mode)")
+    args = ap.parse_args(argv)
+    return diff(load_record(args.old), load_record(args.new), args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

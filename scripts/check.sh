@@ -178,5 +178,27 @@ else
   exit 1
 fi
 
+# ---- cluster observability smoke (ISSUE 7): a real 2-process heartbeat
+# run must merge rank 1's piggybacked snapshots on rank 0 — the script
+# asserts the cluster phase table renders with both rank columns and at
+# least one aggregated per-rank registry series.
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/cluster_smoke.py; then
+  echo "check.sh: cluster smoke OK (2-process heartbeat merge)"
+else
+  echo "check.sh: cluster SMOKE FAILED"
+  exit 1
+fi
+
+# ---- bench trajectory diff (informational): compare the two newest
+# BENCH_*.json records' phase shares / throughput / wire bytes — the
+# first reader of the records PR 5/6 started embedding.  Never gates.
+bench_pair=$(ls -t BENCH_*.json 2>/dev/null | head -2)
+if [[ $(printf '%s\n' "$bench_pair" | sed '/^$/d' | wc -l) -eq 2 ]]; then
+  newest=$(printf '%s\n' "$bench_pair" | head -1)
+  prev=$(printf '%s\n' "$bench_pair" | tail -1)
+  echo "check.sh: bench diff $prev -> $newest (informational)"
+  python scripts/bench_diff.py "$prev" "$newest" --informational || true
+fi
+
 echo "check.sh: OK — no new failures ($(printf '%s\n' "$failures" | sed '/^$/d' | wc -l) known)"
 exit 0

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""2-process heartbeat smoke for the cluster observability plane.
+
+Drives the real TCP fabric — rank 0's `_Heartbeat` server in this
+process, rank 1 as a subprocess running this same file — with a live
+timeline on both sides, and asserts the tentpole contract end to end:
+
+- rank 1's stats frames ride the heartbeat piggyback to rank 0,
+- rank 0 merges them into per-rank registry series
+  (``cluster_phase_share_pct{rank="1", ...}``), and
+- the cluster-merged phase table renders with a column per rank.
+
+No jax.distributed, no collectives: the heartbeat fabric is plain TCP,
+which is exactly why telemetry piggybacks on it.  Run directly or via
+``scripts/check.sh``; exits nonzero (with a diagnostic) on any missing
+piece.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _work(seconds: float, step_s: float) -> None:
+    """Accumulate recognizable timeline phases for ~``seconds``."""
+    from sparknet_tpu.telemetry import timeline
+
+    tl = timeline.Timeline(fence=False)
+    timeline.set_current(tl)
+    tl.start()
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        with tl.phase("input_wait"):
+            time.sleep(step_s / 4)
+        with tl.phase("compiled_step"):
+            time.sleep(step_s)
+
+
+def child(port: int) -> None:
+    from sparknet_tpu.parallel.multihost import _Heartbeat
+
+    hb = _Heartbeat("127.0.0.1", port, 1, 2, interval=0.1, timeout=10.0)
+    _work(2.0, 0.02)
+    hb.close()
+
+
+def main() -> int:
+    from sparknet_tpu.parallel.multihost import _Heartbeat
+    from sparknet_tpu.telemetry import REGISTRY, aggregate
+    from sparknet_tpu.telemetry.exporter import render_prometheus
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    hb = _Heartbeat("127.0.0.1", port, 0, 2, interval=0.1, timeout=10.0)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "child", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        _work(2.0, 0.01)
+        out = proc.communicate(timeout=60)[0].decode()
+        if proc.returncode != 0:
+            print(f"cluster_smoke: rank 1 failed:\n{out}")
+            return 1
+        aggregate.self_ingest()
+        agg = aggregate.get_aggregator()
+        assert agg is not None, "rank 0 heartbeat did not init the aggregator"
+        snap = agg.snapshot()
+        assert "1" in snap["ranks"], f"rank 1 never merged: {snap}"
+        assert snap["ranks"]["1"]["phases"], "rank 1 payload had no phases"
+        table = agg.table()
+        print("cluster: phase table (per-rank shares of loop wall time)")
+        for line in table.splitlines():
+            print(f"  {line}")
+        assert "r0" in table and "r1" in table, table
+        assert "compiled_step" in table, table
+        prom = render_prometheus(registry=REGISTRY)
+        series = [
+            ln for ln in prom.splitlines()
+            if ln.startswith("sparknet_cluster_phase_share_pct")
+            and 'rank="1"' in ln
+        ]
+        assert series, "no aggregated per-rank series in the registry"
+        print(f"cluster_smoke: OK ({len(series)} rank-1 series, "
+              f"{snap['rounds']} aggregation rounds)")
+        return 0
+    finally:
+        hb.close()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "child":
+        child(int(sys.argv[2]))
+    else:
+        sys.exit(main())

@@ -477,6 +477,17 @@ def main(argv=None) -> Dict[str, float]:
             print("telemetry: step-time breakdown")
             for line in tl.table().splitlines():
                 print(f"  {line}")
+            drops = telemetry.trace.dropped_spans()
+            if drops:
+                print(f"  trace: {drops} span(s) dropped (ring buffer)")
+        # cluster-merged phase table when the heartbeat piggyback ran
+        # (same discipline as cifar_app.train_loop)
+        telemetry.aggregate.self_ingest()
+        agg = telemetry.aggregate.get_aggregator()
+        if agg is not None and agg.has_data():
+            print("cluster: phase table (per-rank shares of loop wall time)")
+            for line in agg.table().splitlines():
+                print(f"  {line}")
         # comm/tau record lines, same discipline as cifar_app.train_loop
         if hasattr(solver, "comm_report"):
             import json as _json
@@ -503,6 +514,8 @@ def _fit(solver, feed, args, timer, primary) -> Dict[str, float]:
 
 
 def _fit_loop(solver, feed, args, timer, primary) -> Dict[str, float]:
+    from ..telemetry import anomaly as _anomaly
+
     metrics: Dict[str, float] = {}
     while solver.iter < args.max_iter:
         # stop at the nearest of: next display chunk, next snapshot
@@ -514,13 +527,16 @@ def _fit_loop(solver, feed, args, timer, primary) -> Dict[str, float]:
                 targets.append((solver.iter // interval + 1) * interval)
         prev_iter = solver.iter
         timer.update(0)  # reset: exclude snapshot/feed-setup wall time
-        m = solver.step(
-            feed, min(targets) - solver.iter,
-            log_fn=lambda it, mm: primary and print(
-                f"Iteration {it}, loss = {mm['loss']:.5f}, "
-                f"mlm_acc = {mm['mlm_acc']:.4f}"
-            ),
-        )
+        def _log_iter(it, mm):
+            # loss-spike stream (telemetry/anomaly.py) at display cadence
+            _anomaly.observe_loss(float(mm["loss"]))
+            if primary:
+                print(
+                    f"Iteration {it}, loss = {mm['loss']:.5f}, "
+                    f"mlm_acc = {mm['mlm_acc']:.4f}"
+                )
+
+        m = solver.step(feed, min(targets) - solver.iter, log_fn=_log_iter)
         if m:  # a preempted chunk may return {} — keep the last real one
             metrics = {k: float(v) for k, v in m.items()}  # host sync
         if primary and args.display:

@@ -337,7 +337,11 @@ def train_loop(
     solver: Solver, train_feed, test_feed, log=print, timer=None
 ) -> Dict[str, float]:
     from .. import chaos
+    from ..telemetry import aggregate as _aggregate
+    from ..telemetry import anomaly as _anomaly
+    from ..telemetry import flight as _flight
     from ..telemetry import timeline as _ttl
+    from ..telemetry import trace as _trace
     from ..utils.profiling import StepTimer
 
     # per-iteration phase attribution: NULL unless the app enabled it
@@ -354,6 +358,11 @@ def train_loop(
         # every process computes (collectives are SPMD); only process 0
         # speaks and writes — the reference's driver-side duties
         log = lambda *a, **k: None
+    # flight recorder (telemetry/flight.py): every loop log line also
+    # lands in the bounded ring for the crash dump — identity when the
+    # recorder is off, so non-primary ranks keep their postmortem
+    # context even though their stdout stays quiet
+    log = _flight.tee_log(log)
     if timer is None:
         shapes = solver.train_net.blob_shapes
         data_name = "data" if "data" in shapes else next(iter(shapes), None)
@@ -436,19 +445,25 @@ def train_loop(
             nxt = min(targets)
             prev_iter = solver.iter
             timer.update(0)  # reset window: exclude eval/snapshot time
-            m = solver.step(
-                train_feed,
-                nxt - solver.iter,
-                log_fn=lambda it, mm: log(
-                    f"Iteration {it}, "
-                    f"loss = {mm.get('loss', float('nan')):.5f}"
-                ),
-            )
+
+            def _log_iter(it, mm):
+                loss = mm.get("loss", float("nan"))
+                if loss == loss:  # NaN never feeds the spike detector
+                    _anomaly.observe_loss(loss)
+                log(f"Iteration {it}, loss = {loss:.5f}")
+
+            t_chunk = time.time()
+            m = solver.step(train_feed, nxt - solver.iter, log_fn=_log_iter)
             if sp.display:
                 if m:  # host sync: the window measures completed compute
                     jax.block_until_ready(next(iter(m.values())))
                 timer.update(solver.iter - prev_iter)
                 log(f"    speed: {timer.format()}")
+                if solver.iter > prev_iter:
+                    # step-time spike stream (EMA+MAD, display cadence)
+                    _anomaly.observe_step(
+                        (time.time() - t_chunk) / (solver.iter - prev_iter)
+                    )
             if solver.stop_requested:
                 solver.stop_requested = False  # consumed: solver reusable
                 if sp.snapshot_prefix:
@@ -499,6 +514,25 @@ def train_loop(
         # eval / snapshot, exclusive times (docs/OBSERVABILITY.md)
         log("telemetry: step-time breakdown")
         for line in tl.table().splitlines():
+            log(f"  {line}")
+        drops = _trace.dropped_spans()
+        serr = _trace.sidecar_errors()
+        if drops or serr:
+            # the trace's own losses stop being silent truncation: ring
+            # evictions and unreadable sidecars print with the table
+            log(
+                f"  trace: {drops} span(s) dropped (ring buffer), "
+                f"{serr} sidecar merge error(s)"
+            )
+    # the cluster view (telemetry/aggregate.py): when the heartbeat
+    # piggyback merged per-rank snapshots, rank 0 prints the
+    # cluster-wide phase table — per-rank skew instead of rank-local
+    # numbers (docs/OBSERVABILITY.md "Cluster level")
+    _aggregate.self_ingest()
+    agg = _aggregate.get_aggregator()
+    if agg is not None and agg.has_data() and multihost.is_primary():
+        log("cluster: phase table (per-rank shares of loop wall time)")
+        for line in agg.table().splitlines():
             log(f"  {line}")
     return last_test
 

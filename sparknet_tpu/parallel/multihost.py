@@ -35,6 +35,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..telemetry import aggregate as _aggregate
 from ..telemetry import timeline as _timeline
 from ..telemetry import trace as _trace
 
@@ -42,6 +43,13 @@ from ..telemetry import trace as _trace
 # scripts/launch_multihost.sh + --auto-resume) treats any non-zero exit
 # as restart-the-job. Distinct from ordinary crashes to aid triage.
 EXIT_PEER_FAILURE = 43
+
+# Telemetry piggyback (telemetry/aggregate.py): after an acked ping a
+# worker may send one *stats frame* — this sentinel int32, then
+# ``!ii`` (rank, payload length), then the JSON payload — acked in the
+# same 3-byte slot.  INT32_MIN can never collide with a ping (pid >= 0)
+# or a graceful bye (-1 - pid, pids far below 2**31 - 1).
+_STATS_TAG = -(2 ** 31)
 
 _heartbeat: Optional["_Heartbeat"] = None
 
@@ -106,17 +114,25 @@ class _Heartbeat:
         self._disarmed = False  # set when process 0 announced clean end
         self._ending = False  # process 0: close() underway, answer "end"
         self._silent = False  # chaos multihost.peer_silence engaged
+        # telemetry piggyback: rank 0 merges stats frames into the
+        # cluster aggregator; workers publish one frame per acked ping
+        # (SPARKNET_CLUSTER_TELEMETRY=0 turns the piggyback off)
+        self._publisher = None
         if pid == 0:
             self._last_seen = {}
             self._expected = set(range(1, nprocs))
             self._conns = set()  # live worker conns, for the end broadcast
             self._lock = threading.Lock()
+            if _aggregate.enabled():
+                _aggregate.init_aggregator()
             self._server = socket.create_server(
                 ("", port), backlog=nprocs, reuse_port=False
             )
             self._spawn(self._accept_loop)
             self._spawn(self._monitor_loop)
         else:
+            if _aggregate.enabled():
+                self._publisher = _aggregate.RankPublisher(pid)
             self._spawn(self._client_loop)
 
     def _spawn(self, fn):
@@ -170,6 +186,25 @@ class _Heartbeat:
                         if len(raw) < 4:
                             return  # peer closed; monitor ages it out
                         (peer,) = struct.unpack("!i", raw)
+                        if peer == _STATS_TAG:
+                            # telemetry piggyback: bounded JSON payload
+                            # merged into the cluster aggregator; any
+                            # framing violation drops the connection
+                            # (liveness is the pings' job, not this)
+                            hdr = _recv_exactly(conn, 8)
+                            if len(hdr) < 8:
+                                return
+                            rank, length = struct.unpack("!ii", hdr)
+                            if not 0 <= length <= _aggregate.MAX_PAYLOAD_BYTES:
+                                return
+                            payload = _recv_exactly(conn, length)
+                            if len(payload) < length:
+                                return
+                            _aggregate.ingest(payload, fallback_rank=rank)
+                            conn.sendall(
+                                b"end" if self._ending else b"ok\n"
+                            )
+                            continue
                         if peer < 0:  # graceful bye: stop expecting -1-peer
                             with self._lock:
                                 self._expected.discard(-1 - peer)
@@ -216,6 +251,10 @@ class _Heartbeat:
         grace_until = time.monotonic() + self._join_grace()
         while not self._stop.is_set():
             time.sleep(self.interval)
+            # fold rank 0's own telemetry into the cluster aggregate at
+            # the same cadence the workers publish at (no-op when the
+            # piggyback is disabled)
+            _aggregate.self_ingest()
             now = time.monotonic()
             with self._lock:
                 seen = dict(self._last_seen)
@@ -273,8 +312,28 @@ class _Heartbeat:
                     conn = None
             if conn is not None:
                 try:
-                    conn.sendall(ping)
-                    ack = _recv_exactly(conn, 3)
+                    # one ping, then (telemetry piggyback) at most one
+                    # stats frame — each acked in the same 3-byte slot,
+                    # so the end-broadcast semantics hold for both
+                    msgs = [ping]
+                    if self._publisher is not None:
+                        try:
+                            payload = self._publisher.payload()
+                        except Exception:
+                            payload = None  # stats must not kill liveness
+                        if payload:
+                            msgs.append(
+                                struct.pack(
+                                    "!iii", _STATS_TAG, self.pid,
+                                    len(payload),
+                                ) + payload
+                            )
+                    ack = b""
+                    for msg in msgs:
+                        conn.sendall(msg)
+                        ack = _recv_exactly(conn, 3)
+                        if ack != b"ok\n":
+                            break
                     if ack == b"end":
                         # process 0 finished cleanly: disarm the
                         # watchdog so tail work here (τ tail, slow

@@ -97,11 +97,23 @@ class TauController:
 
     # ------------------------------------------------------------------
     def observe_round(
-        self, *, round_s: float, sync_s: float, loss: float
+        self, *, round_s: float, sync_s: float, loss: float,
+        advisories=None,
     ) -> int:
-        """Digest one round's telemetry; returns the next round's τ."""
+        """Digest one round's telemetry; returns the next round's τ.
+
+        ``advisories`` is the anomaly board's consumable hook
+        (``telemetry.anomaly.active("straggler")``): while a straggler
+        advisory is live, every sync waits on the slow rank, so the
+        widen threshold halves — amortizing the straggler's barrier
+        cost is exactly what more local steps buy (FireCaffe's
+        slowest-participant observation, closed-loop)."""
         self._round += 1
         share = (sync_s / round_s) if round_s > 0 else 0.0
+        straggler = any(
+            a.get("kind") == "straggler" for a in (advisories or ())
+        )
+        widen_share = self.widen_share * (0.5 if straggler else 1.0)
         if self._loss_ema is None:
             self._loss_ema = loss
         divergence = (
@@ -118,11 +130,12 @@ class TauController:
                 f"divergence {divergence:.1%} > {self.narrow_divergence:.0%}"
             )
             self._cooldown = self.cooldown_rounds
-        elif share > self.widen_share and self.tau < self.tau_max:
+        elif share > widen_share and self.tau < self.tau_max:
             # sync-bound: double the local work each round amortizes
             self.tau = min(self.tau_max, self.tau * 2)
             action, why = "widen", (
-                f"sync share {share:.1%} > {self.widen_share:.0%}"
+                f"sync share {share:.1%} > {widen_share:.0%}"
+                + (" (straggler advisory active)" if straggler else "")
             )
             self._cooldown = self.cooldown_rounds
         # EMA after the divergence test: the test compares THIS round
@@ -134,20 +147,21 @@ class TauController:
         self._g_tau.set(self.tau)
         self._g_share.set(round(100.0 * share, 2))
         self._g_div.set(round(100.0 * divergence, 2))
-        self.decisions.append(
-            {
-                "round": self._round,
-                "tau": prev_tau,
-                "next_tau": self.tau,
-                "action": action,
-                "reason": why,
-                "sync_share": round(share, 4),
-                "divergence": round(divergence, 4),
-                "round_s": round(round_s, 5),
-                "sync_s": round(sync_s, 5),
-                "loss": round(float(loss), 6),
-            }
-        )
+        decision = {
+            "round": self._round,
+            "tau": prev_tau,
+            "next_tau": self.tau,
+            "action": action,
+            "reason": why,
+            "sync_share": round(share, 4),
+            "divergence": round(divergence, 4),
+            "round_s": round(round_s, 5),
+            "sync_s": round(sync_s, 5),
+            "loss": round(float(loss), 6),
+        }
+        if straggler:
+            decision["straggler_advisory"] = True
+        self.decisions.append(decision)
         return self.tau
 
     # ------------------------------------------------------------------

@@ -44,6 +44,7 @@ from .local_sgd import (
     round_batch_sharding,
     stack_round_batches,
 )
+from ..telemetry import anomaly as _anomaly
 from .mesh import DP_AXIS, batch_sharding, make_mesh, replicate
 from .tau_controller import TauController, parse_tau
 from . import multihost
@@ -414,10 +415,19 @@ class ParallelSolver(Solver):
                     phases1.get(k, 0.0) - (phases0 or {}).get(k, 0.0)
                     for k in ("grad_allreduce", "multihost_sync")
                 )
+                # anomaly advisory hook: only consumed single-process —
+                # straggler advisories live on rank 0's board, and a
+                # multi-host run needs every rank to pick the same τ
+                # (consuming rank-0-only signal would diverge them)
+                advisories = (
+                    _anomaly.active("straggler")
+                    if multihost.process_count() == 1 else None
+                )
                 self.tau = controller.observe_round(
                     round_s=max(tl.wall_s - wall0, 1e-9),
                     sync_s=sync_s,
                     loss=float(metrics.get("loss", 0.0)),
+                    advisories=advisories,
                 )
             d = self.sp.display
             if log_fn and d:

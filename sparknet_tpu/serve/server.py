@@ -8,7 +8,13 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
   Shape errors -> 400; queue backpressure -> 503 with Retry-After.
 - ``GET /healthz`` — liveness + model identity + bucket config; the
   ``status`` field degrades to ``"degraded"`` while requests are being
-  shed/cancelled (deadline pressure), so balancers can back off.
+  shed/cancelled (deadline pressure) or while a ``queue_stall`` /
+  ``straggler`` anomaly advisory is live (the ``anomalies`` field
+  carries the active list; telemetry/anomaly.py), so balancers can
+  back off.
+- ``GET /dash`` — the zero-dependency HTML dashboard
+  (telemetry/dash.py): stat tiles, latency SLO gauges, per-rank
+  phase-share bars, the anomaly feed; re-rendered live per request.
 - ``GET /metrics`` — Prometheus text format (0.0.4): the process-wide
   telemetry registry plus the serving families (request/error/shed
   counters, queue-depth gauge, request/device latency histograms) —
@@ -101,10 +107,26 @@ class InferenceServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    from ..telemetry import anomaly as _anomaly
+
+                    # scrape-driven stall detection: a monitored server
+                    # is exactly one that gets health-checked
+                    _anomaly.observe_serve(outer.metrics)
+                    active = _anomaly.active()
+                    status = outer.metrics.health()
+                    if status == "ok" and any(
+                        a.get("kind") in ("queue_stall", "straggler")
+                        for a in active
+                    ):
+                        # a live stall/straggler advisory degrades the
+                        # server exactly like shed/cancelled pressure
+                        # does (and clears when the advisory expires,
+                        # the PR-3 degraded-window semantics)
+                        status = "degraded"
                     self._reply(
                         200,
                         {
-                            "status": outer.metrics.health(),
+                            "status": status,
                             "model": outer.model_name,
                             "buckets": list(
                                 getattr(outer.engine, "buckets", ())
@@ -112,7 +134,28 @@ class InferenceServer:
                             "output": getattr(outer.engine, "output", None),
                             "shed": outer.metrics.shed,
                             "cancelled": outer.metrics.cancelled,
+                            "anomalies": active,
                         },
+                    )
+                elif self.path == "/dash":
+                    # the zero-dependency live dashboard
+                    # (telemetry/dash.py, docs/OBSERVABILITY.md)
+                    from ..telemetry import REGISTRY
+                    from ..telemetry import aggregate as _aggregate
+                    from ..telemetry import anomaly as _anomaly
+                    from ..telemetry import dash as _dash
+
+                    _anomaly.observe_serve(outer.metrics)
+                    agg = _aggregate.get_aggregator()
+                    page = _dash.render_html(
+                        REGISTRY.snapshot(),
+                        serve_metrics=outer.metrics.snapshot(),
+                        cluster=agg.snapshot() if agg is not None else None,
+                        anomalies=_anomaly.active(),
+                        model_name=outer.model_name,
+                    )
+                    self._send(
+                        200, page.encode(), "text/html; charset=utf-8"
                     )
                 elif self.path == "/metrics":
                     # Prometheus text exposition: the process registry

@@ -7,7 +7,12 @@ in a supervised child — ``multihost._die`` (peer failure), the apps'
 top-level exception handler, the ``supervisor.child_crash`` chaos site
 — writes one small JSON file into ``{run_dir}/failures/``: who died
 (process id), why (kind + reason), the exit code, and the last
-completed training iteration.  The supervisor reads the records of
+completed training iteration.  When the crash flight recorder
+(``telemetry/flight.py``) is armed — it is, in every supervised child —
+its bounded dump (last K events, log lines, phase breakdown, registry
+snapshot) is written alongside and referenced by the record's
+``flight_recorder`` field, so the postmortem starts from structured
+context instead of log archaeology.  The supervisor reads the records of
 each failed generation to attribute the failure to a rank (the elastic
 degrade signal) and synthesizes a record for any child that died too
 hard to write its own (SIGKILL, OOM).
@@ -94,6 +99,18 @@ def write_failure_record(
     try:
         if generation is None:
             generation = int(os.environ.get(GENERATION_ENV, "-1") or -1)
+        # flight recorder first (telemetry/flight.py): the dump lands
+        # next to the record and the record references it, so the
+        # postmortem has the process's last K events/logs instead of
+        # whatever stderr survived.  None when the recorder is off.
+        try:
+            from ..telemetry import flight
+
+            flight_path = flight.dump(
+                failures_dir(root), tag=f"g{generation}-p{process_id}"
+            )
+        except Exception:
+            flight_path = None
         record = {
             "version": RECORD_VERSION,
             "time": time.time(),
@@ -104,6 +121,7 @@ def write_failure_record(
             "reason": reason,
             "exit_code": exit_code,
             "last_completed_iteration": last_completed_iteration(),
+            "flight_recorder": flight_path,
         }
         if extra:
             record.update(extra)
@@ -157,7 +175,9 @@ def read_failure_records(
     except OSError:
         return out
     for name in names:
-        if not name.endswith(".json"):
+        # only failure records: flight-recorder dumps share the
+        # directory (referenced BY records, never records themselves)
+        if not name.startswith("failure-") or not name.endswith(".json"):
             continue
         try:
             with open(os.path.join(d, name)) as fh:

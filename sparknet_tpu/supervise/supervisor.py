@@ -381,6 +381,16 @@ class Supervisor:
             blamed = self._attribute(recs, exits)
             entry["records"] = recs
             entry["blamed_rank"] = blamed
+            # the children's flight-recorder dumps (telemetry/flight.py)
+            # are the postmortem's starting point — surface them in the
+            # report and the log instead of leaving them to be found
+            flights = [
+                r["flight_recorder"] for r in recs
+                if r.get("flight_recorder")
+            ]
+            entry["flight_recorders"] = flights
+            for path in flights:
+                _log(f"flight recorder dump: {path}")
             was_healthy = duration >= self.cfg.healthy_s
             if was_healthy:
                 policy.note_healthy_run()

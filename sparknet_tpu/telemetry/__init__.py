@@ -23,6 +23,19 @@ cross-host sync, or snapshot I/O?  This package is the one substrate:
 - :mod:`~sparknet_tpu.telemetry.exporter` — Prometheus text rendering
   (mounted on the serve server's ``GET /metrics``) and the periodic
   ``telemetry:`` log line (``SPARKNET_TELEMETRY_INTERVAL_S``).
+- :mod:`~sparknet_tpu.telemetry.aggregate` — the *cluster* level:
+  per-rank snapshots piggybacked on the multihost heartbeat fabric,
+  merged on rank 0 into per-rank label series and a cluster-wide phase
+  table with skew columns.
+- :mod:`~sparknet_tpu.telemetry.anomaly` — deterministic detectors
+  over the aggregated stream (stragglers, EMA+MAD step/loss spikes,
+  queue stalls) firing registry counters, ``anomaly:`` JSON lines, and
+  advisories the tau controller and serve ``/healthz`` consume.
+- :mod:`~sparknet_tpu.telemetry.flight` — bounded crash flight
+  recorder, dumped next to (and referenced from) ``supervise/records``
+  failure records on any crash path.
+- :mod:`~sparknet_tpu.telemetry.dash` — the zero-dependency HTML
+  dashboard the serve server mounts on ``GET /dash``.
 
 Enable per run with ``--trace OUT.json`` on the apps / ``caffe train``
 (or ``SPARKNET_TRACE=OUT.json``); see docs/OBSERVABILITY.md.
@@ -37,7 +50,7 @@ import contextlib
 import os
 from typing import Optional
 
-from . import exporter, timeline, trace
+from . import aggregate, anomaly, dash, exporter, flight, timeline, trace
 from .registry import (
     REGISTRY,
     Counter,
@@ -54,8 +67,12 @@ __all__ = [
     "LatencyHistogram",
     "NamedCounters",
     "Registry",
+    "aggregate",
+    "anomaly",
+    "dash",
     "exporter",
     "finish_run",
+    "flight",
     "install_for_training",
     "timeline",
     "trace",
@@ -86,6 +103,10 @@ def install_for_training(solver, trace_path: Optional[str] = None):
     if path or os.environ.get("SPARKNET_TIMELINE", "") not in ("", "0"):
         solver.timeline = timeline.Timeline()
         timeline.set_current(solver.timeline)
+    # arm the crash flight recorder where a postmortem consumer exists
+    # (supervised children, or SPARKNET_FLIGHT=1); disabled it stays
+    # the allocation-free no-op
+    flight.configure_from_env()
     return path
 
 
@@ -115,6 +136,15 @@ def finish_run() -> None:
         try:
             trace.write()
         finally:
+            errs = trace.sidecar_errors()
+            if errs:
+                # the merge just ran: losses surface here, not only in
+                # the registry counter
+                print(
+                    f"trace: {errs} sidecar merge error(s) — those part "
+                    f"files are missing from the merged trace",
+                    flush=True,
+                )
             trace.disable()
     if _saved_trace_env is not None:
         prev = _saved_trace_env[0]

@@ -179,9 +179,22 @@ def maybe_start_periodic(
     registry = registry if registry is not None else REGISTRY
     stop_ev = threading.Event()
 
+    def poll_anomalies():
+        # the input pipeline has no scrape surface, so its queue-stall
+        # check rides the flush cadence (telemetry/anomaly.py)
+        src = registry.sources().get("pipeline")
+        if src is not None:
+            from . import anomaly
+
+            try:
+                anomaly.observe_pipeline(src.snapshot())
+            except Exception:
+                pass  # a dying source must not kill the flush thread
+
     def loop():
         while not stop_ev.wait(interval):
             try:
+                poll_anomalies()
                 emit(f"telemetry: {registry.json_line()}")
             except Exception:
                 return  # a closed log sink must not crash the run

@@ -1,0 +1,172 @@
+"""Crash flight recorder — bounded, always-on once armed, dump-on-death.
+
+PR 4's failure records say *who* died and *when*; what the process was
+doing in the seconds before has so far depended on whatever stderr the
+launcher happened to keep.  The flight recorder fixes that the way an
+aircraft one does: a bounded in-memory ring of recent evidence — log
+lines, anomaly firings, phase snapshots, subsystem notes — that costs
+two deque appends per event while alive and is written to disk only at
+death.  ``records.write_failure_record`` (every crash path: the apps'
+handler, ``multihost._die``, the ``supervisor.child_crash`` chaos site)
+dumps it next to the failure record and references it from the record,
+so a postmortem starts from structured context instead of log
+archaeology.
+
+Arming: :func:`configure_from_env` — on under supervision
+(``SPARKNET_SUPERVISE_DIR`` is exported into every supervised child)
+or explicitly with ``SPARKNET_FLIGHT=1``; ``SPARKNET_FLIGHT=0`` forces
+off.  The disabled path is the PR-5 no-op discipline: ``note()`` is
+one module-bool test, ``tee_log()`` returns the caller's function
+object unchanged — allocation-free, pinned by test.
+
+The dump bundles the rings with the live registry snapshot, the
+current timeline breakdown, recent anomalies, and (when the span
+tracer is on) the tail of its ring — one JSON file, bounded by the
+ring capacities, never raising on any failure path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+ENABLE_ENV = "SPARKNET_FLIGHT"
+
+_lock = threading.Lock()
+_enabled = False
+_events: Optional[deque] = None
+_logs: Optional[deque] = None
+_dumped = 0
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(capacity: int = 512, log_capacity: int = 200) -> None:
+    global _enabled, _events, _logs
+    with _lock:
+        _events = deque(maxlen=capacity)
+        _logs = deque(maxlen=log_capacity)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled, _events, _logs, _dumped
+    _enabled = False
+    with _lock:
+        _events = None
+        _logs = None
+    _dumped = 0
+
+
+def configure_from_env() -> bool:
+    """Arm the recorder when the environment says a postmortem consumer
+    exists: explicit ``SPARKNET_FLIGHT=1``, or a supervised run
+    (``SPARKNET_SUPERVISE_DIR`` set) unless ``SPARKNET_FLIGHT=0``.
+    Returns whether the recorder is (now) enabled."""
+    raw = os.environ.get(ENABLE_ENV, "").strip()
+    if raw == "0":
+        return False
+    if raw and raw != "0":
+        if not _enabled:
+            enable()
+        return True
+    if os.environ.get("SPARKNET_SUPERVISE_DIR"):
+        if not _enabled:
+            enable()
+        return True
+    return _enabled
+
+
+def note(kind: str, **fields) -> None:
+    """Record one structured event.  The disabled path is the module
+    bool — nothing allocated, nothing locked."""
+    if not _enabled:
+        return
+    ev = {"kind": kind, "t": round(time.time(), 3), **fields}
+    with _lock:
+        if _events is not None:
+            _events.append(ev)
+
+
+def add_log(line: str) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        if _logs is not None:
+            _logs.append(str(line))
+
+
+def tee_log(fn):
+    """Wrap a log function so every line also lands in the ring.  When
+    disabled this returns ``fn`` itself — the caller's hot path keeps
+    the exact object it passed in."""
+    if not _enabled:
+        return fn
+
+    def teed(*args, **kwargs):
+        if _enabled and args:
+            add_log(" ".join(str(a) for a in args))
+        return fn(*args, **kwargs)
+
+    return teed
+
+
+def snapshot() -> Dict[str, Any]:
+    """The recorder's whole state as one JSON-able dict."""
+    from . import anomaly, timeline, trace
+    from .registry import REGISTRY
+
+    with _lock:
+        events = list(_events) if _events is not None else []
+        logs = list(_logs) if _logs is not None else []
+    out: Dict[str, Any] = {
+        "version": 1,
+        "time": time.time(),
+        "pid": os.getpid(),
+        "process_id": os.environ.get("SPARKNET_PROCESS_ID", "0") or "0",
+        "events": events,
+        "logs": logs,
+        "anomalies": anomaly.recent(),
+    }
+    try:
+        out["timeline"] = timeline.current().snapshot()
+    except Exception:
+        out["timeline"] = {}
+    try:
+        out["registry"] = REGISTRY.snapshot()
+    except Exception:
+        out["registry"] = {}
+    if trace.enabled():
+        # the span ring's tail rides along when tracing is on — the
+        # recorder never runs its own span capture (bounded cost rule)
+        out["trace_tail"] = trace.events()[-100:]
+    return out
+
+
+def dump(directory: str, tag: str = "") -> Optional[str]:
+    """Write the flight dump into ``directory``; returns the path, or
+    None when disabled/empty-dir.  Never raises — every caller is a
+    dying path."""
+    global _dumped
+    if not _enabled or not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with _lock:
+            _dumped += 1
+            n = _dumped
+        name = f"flight-{tag + '-' if tag else ''}{os.getpid()}-{n}.json"
+        path = os.path.join(directory, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snapshot(), fh, indent=1, default=str)
+        os.replace(tmp, path)  # a postmortem never reads a torn dump
+        return path
+    except Exception:
+        return None

@@ -58,6 +58,23 @@ _role = "owner"
 _events: Optional[deque] = None
 _thread_names: Dict[int, str] = {}
 _atexit_armed = False
+# ring evictions + part-file merge failures: truncation used to be
+# silent — now both are registry counters (trace_dropped_spans /
+# trace_sidecar_errors) and surface in the end-of-run table
+_dropped_spans = 0
+_sidecar_errors = 0
+
+
+def dropped_spans() -> int:
+    """Spans evicted by the bounded ring this enable-session."""
+    with _lock:
+        return _dropped_spans
+
+
+def sidecar_errors() -> int:
+    """Part files the owner's merge could not read (torn/racing)."""
+    with _lock:
+        return _sidecar_errors
 
 
 def enabled() -> bool:
@@ -156,9 +173,20 @@ def record(
     }
     if args:
         ev["args"] = args
+    global _dropped_spans
+    dropped = False
     with _lock:
         if _events is not None:
+            if len(_events) == _events.maxlen:
+                _dropped_spans += 1
+                dropped = True
             _events.append(ev)
+    if dropped:
+        # counted outside the ring lock; the registry counter has its
+        # own — scrapes see the drop, the end-of-run table prints it
+        from .registry import REGISTRY
+
+        REGISTRY.counter("trace_dropped_spans").inc()
 
 
 def events() -> list:
@@ -176,8 +204,11 @@ def enable(path: Optional[str] = None, capacity: int = 65536) -> None:
     resolves to a sidecar writer.  Multi-host ranks other than 0 are
     sidecars regardless (``SPARKNET_PROCESS_ID``)."""
     global _enabled, _path, _role, _events, _atexit_armed
+    global _dropped_spans, _sidecar_errors
     with _lock:
         _events = deque(maxlen=capacity)
+        _dropped_spans = 0
+        _sidecar_errors = 0
     _thread_names.clear()
     _path = path or None
     owner_pid = os.environ.get(OWNER_PID_ENV, "")
@@ -293,6 +324,7 @@ def write(path: Optional[str] = None) -> Optional[str]:
         return None
     if _role == "sidecar":
         return flush_sidecar()
+    global _sidecar_errors
     evts = _meta_events(events()) + events()
     for part in sorted(_glob.glob(f"{path}.part-*.json")):
         try:
@@ -300,7 +332,14 @@ def write(path: Optional[str] = None) -> Optional[str]:
                 evts.extend(json.load(fh))
             os.remove(part)
         except (OSError, ValueError):
-            continue  # a torn/racing part must not kill the export
+            # a torn/racing part must not kill the export — but the
+            # miss is counted, not silent
+            with _lock:
+                _sidecar_errors += 1
+            from .registry import REGISTRY
+
+            REGISTRY.counter("trace_sidecar_errors").inc()
+            continue
     evts.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
     doc = {"traceEvents": evts, "displayTimeUnit": "ms"}
     tmp = path + ".tmp"

@@ -25,7 +25,11 @@ detection, with machine-readable failure records in the run dir and a
 / ``SPARKNET_TRACE`` for the telemetry subsystem (docs/OBSERVABILITY.md:
 the run writes a Perfetto-loadable Chrome trace — pipeline workers and
 supervised children merged in by pid/tid — and prints the per-phase
-step-time breakdown table, the paper's τ-vs-communication accounting).
+step-time breakdown table, the paper's τ-vs-communication accounting;
+on a multi-host run rank 0 additionally prints the cluster-merged
+phase table with per-rank skew from the heartbeat telemetry piggyback,
+and the anomaly detectors emit ``anomaly:`` JSON lines on stragglers,
+step/loss spikes, and queue stalls).
 ``time`` routes to tools/time_net; ``test`` builds the
 TEST-phase net and reports averaged metrics.  Both ``--flag=value``
 and ``--flag value`` spellings are accepted, like the original binary.
