@@ -22,6 +22,12 @@ Env knobs:
                              histogram, bytes-on-wire estimate and the
                              --tau auto controller trajectory
   BENCH_MODEL=input_pipeline host preprocessing A/B (PR 2)
+  BENCH_MODEL=data_plane     packed-record data-plane A/B (PR 8):
+                             legacy in-memory feed vs packed shard
+                             readers cold vs decoded-batch-cache
+                             cached, one epoch each on a synthetic
+                             CIFAR feed — the decode-skip speedup is
+                             host-only and valid on 1 CPU
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
   BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
@@ -538,6 +544,111 @@ def bench_input_pipeline(platform: str) -> dict:
     }
 
 
+def bench_data_plane(platform: str) -> dict:
+    """Data-plane A/B (``BENCH_MODEL=data_plane``): pack a synthetic
+    CIFAR feed, then drain one epoch three ways — legacy in-memory
+    feed, packed shard readers cold (filling the decoded-batch cache),
+    and the same epoch again served from the cache.  Host-only (no
+    training), so the decode-skip speedup is meaningful even on this
+    1-CPU container; cache hit/miss counters ride in the record's
+    telemetry block via the registry source.  Acceptance (ISSUE 8):
+    cached >= 1.5x cold, packed cold within 10% of legacy."""
+    import shutil
+    import tempfile
+
+    from sparknet_tpu.data.cache import ShmBatchCache
+    from sparknet_tpu.data.cifar import cifar10_dataset
+    from sparknet_tpu.data.records import PackedDataset, pack_dataset
+
+    n = int(os.environ.get("BENCH_N", 4096))
+    bs = int(os.environ.get("BENCH_BATCH", 128))
+    epochs = int(os.environ.get("BENCH_ITERS", 2))  # timed epochs per arm
+    tmp = tempfile.mkdtemp(prefix="bench_data_plane_")
+    cache = ShmBatchCache(
+        namespace=f"bench-{os.getpid()}",
+        max_bytes=int(64e6) + n * 3200 * 2,  # the whole epoch must fit
+    )
+    try:
+        legacy_ds, _ = cifar10_dataset(None, train=True, synthetic_n=n)
+        pack_dataset(legacy_ds, tmp)
+        packed = PackedDataset(tmp, cache=cache)
+
+        def drain(make_iter, warm_epochs: int, timed_epochs: int) -> float:
+            """rows/sec over ``timed_epochs`` epochs, after draining
+            ``warm_epochs`` epochs of the SAME iterator untimed.  The
+            steady-state arms warm one epoch (shard open + one-time
+            region verification / first partition decode); the cold
+            cache arm warms zero — epoch 1 IS the measurement."""
+            it = make_iter(warm_epochs + timed_epochs)
+            rows = 0
+            warm_rows = 0
+            t0 = time.perf_counter()
+            for b in it:
+                if warm_rows < warm_epochs * n:
+                    warm_rows += len(b["label"])
+                    if warm_rows >= warm_epochs * n:
+                        t0 = time.perf_counter()
+                    continue
+                rows += len(b["label"])
+            dt = time.perf_counter() - t0
+            getattr(it, "close", lambda: None)()
+            return rows / dt
+
+        legacy_ips = drain(
+            lambda e: legacy_ds.batches(bs, shuffle=True, seed=0, epochs=e),
+            1, epochs,
+        )
+        # pure streaming readers, no cache attached — the format-cost
+        # arm (packed-vs-legacy must be within 10%), steady state like
+        # the legacy arm: both warm one epoch first
+        plain = PackedDataset(tmp)
+        packed_ips = drain(
+            lambda e: plain.batches(bs, shuffle=True, seed=0, epochs=e),
+            1, epochs,
+        )
+        # the genuine cold epoch: empty cache, every batch decodes AND
+        # publishes (misses + puts + first-open verification)...
+        cold_ips = drain(
+            lambda e: packed.batches(bs, shuffle=True, seed=0, epochs=e),
+            0, 1,
+        )
+        cold_stats = dict(cache.metrics.snapshot())
+        # ...vs the cached epochs: a fresh reader (a second co-located
+        # job) served entirely from the shm cache — no shard is even
+        # opened on a full-hit epoch
+        cached_ips = drain(
+            lambda e: packed.batches(bs, shuffle=True, seed=0, epochs=e),
+            0, epochs,
+        )
+        stats = cache.metrics.snapshot()
+        return {
+            "metric": "data_plane_cached_rows_per_sec",
+            "value": round(cached_ips, 2),
+            "unit": "rows/sec",
+            "vs_baseline": None,
+            "platform": platform,
+            "batch_size": bs,
+            "records": n,
+            "epochs": epochs,
+            "legacy_rows_per_sec": round(legacy_ips, 2),
+            "packed_rows_per_sec": round(packed_ips, 2),
+            "cold_rows_per_sec": round(cold_ips, 2),
+            "cached_rows_per_sec": round(cached_ips, 2),
+            # the two acceptance ratios, precomputed for bench_diff and
+            # the check.sh smoke
+            "cached_speedup": round(cached_ips / cold_ips, 3),
+            "packed_vs_legacy_cold": round(packed_ips / legacy_ips, 3),
+            "cache": {
+                "cold": cold_stats,
+                "total": stats,
+            },
+            "host_cpus": os.cpu_count(),
+        }
+    finally:
+        cache.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_comm(platform: str) -> dict:
     """Communication-layer A/B (``BENCH_MODEL=comm``): τ-local-SGD
     rounds of cifar10_quick on a dp mesh, one arm per comm config.
@@ -741,6 +852,8 @@ def main() -> None:
         runner = bench_comm
     elif mode == "input_pipeline":
         runner = bench_input_pipeline
+    elif mode == "data_plane":
+        runner = bench_data_plane
     elif mode in IMAGENET_ARCHS:
         runner = functools.partial(bench_imagenet, arch=mode)
     else:
@@ -748,7 +861,8 @@ def main() -> None:
         # Exception and still emits the JSON error record
         raise ValueError(
             f"BENCH_MODEL={mode!r}: want "
-            f"bert|input_pipeline|{'|'.join(IMAGENET_ARCHS)}"
+            f"bert|input_pipeline|data_plane|comm|"
+            f"{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
         with jax.profiler.trace(profile_dir):
@@ -787,6 +901,8 @@ if __name__ == "__main__":
                         if mode == "input_pipeline"
                         else "comm_round_ms_bucketed_vs_monolithic"
                         if mode == "comm"
+                        else "data_plane_cached_rows_per_sec"
+                        if mode == "data_plane"
                         else f"{mode}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,

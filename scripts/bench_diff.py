@@ -10,8 +10,10 @@ prints a regression table:
     python scripts/bench_diff.py old.json new.json --informational
 
 Rows: headline throughput, step time, each step-phase's share of
-attributed time, and the wire-bytes-per-reduction estimate when a comm
-sub-record exists.  Thresholds (tunable by flag) mark a row REGRESSED;
+attributed time, the wire-bytes-per-reduction estimate when a comm
+sub-record exists, and the data-plane cold/cached epoch throughput
+(+ decode-skip ratio) when the record came from
+``BENCH_MODEL=data_plane``.  Thresholds (tunable by flag) mark a row REGRESSED;
 the exit code is 1 when anything regressed unless ``--informational``
 (the scripts/check.sh invocation) — so the same tool serves both a CI
 trip-wire and a human diff.
@@ -119,6 +121,15 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
         rise = (b - a) / a
         add("wire_bytes_per_reduction", a, b, "B",
             rise > args.wire_pct / 100.0, f"{rise:+.1%}")
+    # data-plane records (BENCH_MODEL=data_plane): cold/cached epoch
+    # throughput and the decode-skip ratio — higher is better for all
+    for key in ("cold_rows_per_sec", "cached_rows_per_sec",
+                "cached_speedup"):
+        a, b = find_key(old, key), find_key(new, key)
+        if a and b:
+            drop = (a - b) / a
+            add(key, a, b, "",
+                drop > args.throughput_pct / 100.0, f"{-drop:+.1%}")
 
     if not rows:
         print("bench_diff: no comparable fields between the two records")

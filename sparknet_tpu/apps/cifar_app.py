@@ -101,6 +101,65 @@ def make_transformer(layer, train: bool, solver_dir: str, fallback_mean=None):
     return t
 
 
+def resolve_packed(args):
+    """``--data-format`` / ``SPARKNET_DATA_FORMAT`` -> (use_packed,
+    packed_dir).  ``packed`` demands a ``--data-dir`` holding a
+    ``sparknet-pack`` output; ``auto`` (the default) uses the packed
+    path exactly when the data dir carries a packed manifest — existing
+    command lines never change behavior.  Shared by both image apps
+    (docs/DATA.md)."""
+    fmt = (
+        getattr(args, "data_format", None)
+        or os.environ.get("SPARKNET_DATA_FORMAT", "").strip()
+        or "auto"
+    )
+    ddir = getattr(args, "data_dir", None)
+    if fmt == "packed":
+        if not ddir:
+            raise ValueError(
+                "--data-format packed requires --data-dir pointing at a "
+                "sparknet-pack output directory"
+            )
+        return True, ddir
+    if fmt == "auto" and ddir and not getattr(args, "synthetic", False):
+        from ..data.records import is_packed
+
+        if is_packed(ddir):
+            return True, ddir
+    return False, None
+
+
+def build_packed(args):
+    """The packed-format data plane for an image app's ``build``:
+    streaming shard readers (+ the cross-job decoded-batch cache when
+    ``--data-cache`` names a namespace) for train, packed test split
+    when the pack wrote one (None otherwise — caller falls back), and
+    the per-pixel mean ``sparknet-pack`` stored at pack time."""
+    from ..data import records as _records
+    from ..data.cache import cache_from_args
+
+    _, packed_dir = resolve_packed(args)
+    cache = cache_from_args(args)
+    train_ds = _records.packed_dataset(packed_dir, train=True, cache=cache)
+    test_ds = None
+    if _records.has_packed_split(packed_dir, "test"):
+        # the eval feed re-reads the same small stream at test_interval
+        # cadence — no cache: eval must never evict training batches
+        test_ds = _records.packed_dataset(packed_dir, train=False)
+    return train_ds, test_ds, train_ds.mean()
+
+
+def print_data_cache_line(log=print) -> None:
+    """One ``data cache:`` JSON line (hit/miss/evict/torn counters) when
+    a decoded-batch cache was active this run — same discipline as the
+    ``chaos:`` / ``input pipeline:`` lines; check.sh asserts on it."""
+    from ..telemetry import REGISTRY
+
+    src = REGISTRY.sources().get("data_cache")
+    if src is not None and multihost.is_primary():
+        log(f"data cache: {src.json_line()}")
+
+
 def make_native_feed(
     ds, transformer: Transformer, batch_size: int, seed: int = 0,
     workers: int = 0,
@@ -185,11 +244,18 @@ def build(args) -> tuple:
     test_bs = _batch_size(test_layer, train_bs)
 
     data_dir = None if args.synthetic else args.data_dir
+    # Packed shard dirs win first (--data-format packed, or auto +
+    # a sparknet-pack manifest under --data-dir: streaming readers,
+    # optional cross-job decoded-batch cache — docs/DATA.md); then
     # Caffe-native sources (LMDB/ImageData/HDF5) referenced by the
-    # prototxt win when present on disk — full data_param fidelity
+    # prototxt when present on disk — full data_param fidelity
     mean = None
     train_ds = test_ds = None
-    if not args.synthetic:
+    use_packed, _ = resolve_packed(args)
+    if use_packed:
+        train_ds, test_ds, mean = build_packed(args)
+        data_dir = None  # a missing packed test split falls back below
+    elif not args.synthetic:
         from ..data.caffe_layers import dataset_from_layer
 
         train_ds = dataset_from_layer(train_layer, solver_dir)
@@ -564,6 +630,21 @@ def arg_parser() -> argparse.ArgumentParser:
                          "feed (-1 auto: SPARKNET_DATA_WORKERS or "
                          "cpu-count aware; 0 serial). The batch stream "
                          "is bit-identical for any count")
+    ap.add_argument("--data-format", choices=("auto", "packed"),
+                    default=None,
+                    help="input format: packed = stream sparknet-pack "
+                         "shard files under --data-dir (CRC-checked "
+                         "records, global shuffle, shard-level resume); "
+                         "auto (default) detects a packed manifest (also "
+                         "SPARKNET_DATA_FORMAT; docs/DATA.md)")
+    ap.add_argument("--data-cache", nargs="?", const="default", default=None,
+                    metavar="NS",
+                    help="cross-job decoded-batch cache namespace for "
+                         "the packed train feed: co-located jobs reading "
+                         "the same stream share decoded batches over "
+                         "named shared memory instead of re-decoding "
+                         "(also SPARKNET_DATA_CACHE; budget "
+                         "SPARKNET_CACHE_MB; docs/DATA.md)")
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
     ap.add_argument("--tau", default="10",
@@ -726,6 +807,9 @@ def main(argv=None):
         pm = getattr(raw_train_feed, "metrics", None)
         if pm is not None and multihost.is_primary():
             print(f"input pipeline: {pm.json_line()}")
+        # cross-job decoded-batch cache counters, before the feed close
+        # drops the (weakly registered) cache source
+        print_data_cache_line()
         getattr(raw_train_feed, "close", lambda: None)()
         if chaos.active() and multihost.is_primary():
             # fires + recoveries, one JSON line — the chaos run's

@@ -31,9 +31,12 @@ from ..parallel import ParallelSolver, make_mesh, multihost
 from .cifar_app import (
     _batch_size,
     _data_layer,
+    build_packed,
     comm_config_from,
     make_native_feed,
+    print_data_cache_line,
     record_loader_meta,
+    resolve_packed,
     train_loop,
 )
 
@@ -121,10 +124,17 @@ def build(args):
 
     data_dir = None if args.synthetic else args.data_dir
     classes = args.synthetic_classes
-    # Caffe-native sources (LMDB/ImageData/HDF5) named in the prototxt
-    # win when present on disk (same policy as CifarApp)
+    # Packed shard dirs first (--data-format packed / auto-detected
+    # sparknet-pack manifest — streaming readers + optional decoded-
+    # batch cache, docs/DATA.md), then Caffe-native sources
+    # (LMDB/ImageData/HDF5) named in the prototxt (CifarApp's policy)
+    packed_mean = None
     train_ds = test_ds = None
-    if not args.synthetic:
+    use_packed, _ = resolve_packed(args)
+    if use_packed:
+        train_ds, test_ds, packed_mean = build_packed(args)
+        data_dir = None  # a missing packed test split falls back below
+    elif not args.synthetic:
         from ..data.caffe_layers import dataset_from_layer
 
         train_ds = dataset_from_layer(train_layer, solver_dir)
@@ -163,11 +173,14 @@ def build(args):
 
     from ..data.imagenet import BGR_MEAN
 
+    fallback_mean = (
+        (lambda: packed_mean) if packed_mean is not None else lambda: BGR_MEAN
+    )
     train_tf = make_transformer(
-        train_layer, True, solver_dir, lambda: BGR_MEAN
+        train_layer, True, solver_dir, fallback_mean
     )
     test_tf = make_transformer(
-        test_layer, False, solver_dir, lambda: BGR_MEAN
+        test_layer, False, solver_dir, fallback_mean
     )
 
     # same source-shape policy as CifarApp (crop wins H/W, channels
@@ -278,6 +291,20 @@ def parser() -> argparse.ArgumentParser:
                          "feed (-1 auto: SPARKNET_DATA_WORKERS or "
                          "cpu-count aware; 0 serial). The batch stream "
                          "is bit-identical for any count")
+    ap.add_argument("--data-format", choices=("auto", "packed"),
+                    default=None,
+                    help="input format: packed = stream sparknet-pack "
+                         "shard files under --data-dir (CRC-checked "
+                         "records, global shuffle, shard-level resume); "
+                         "auto (default) detects a packed manifest (also "
+                         "SPARKNET_DATA_FORMAT; docs/DATA.md)")
+    ap.add_argument("--data-cache", nargs="?", const="default", default=None,
+                    metavar="NS",
+                    help="cross-job decoded-batch cache namespace for "
+                         "the packed train feed (named shared memory, "
+                         "shared with co-located jobs; also "
+                         "SPARKNET_DATA_CACHE / SPARKNET_CACHE_MB; "
+                         "docs/DATA.md)")
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (TPU-native matmul dtype)")
     ap.add_argument("--remat", action="store_true",
@@ -396,6 +423,7 @@ def main(argv=None):
         pm = getattr(raw_train_feed, "metrics", None)
         if pm is not None and multihost.is_primary():
             print(f"input pipeline: {pm.json_line()}")
+        print_data_cache_line()  # decoded-batch cache counters
         getattr(raw_train_feed, "close", lambda: None)()
         if chaos.active() and multihost.is_primary():
             print(f"chaos: {chaos.METRICS.json_line()}")

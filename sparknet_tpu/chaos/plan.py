@@ -55,6 +55,12 @@ FAULT_POINTS: Dict[str, str] = {
         "input-pipeline worker stalls before producing a batch; coords: "
         "batch, worker; params: delay_ms (default 50)"
     ),
+    "data.torn_shard": (
+        "packed shard reader sees a CRC-torn record: skipped with "
+        "counter, replaced by the nearest healthy record of the batch, "
+        "and the batch is excluded from the decoded-batch cache; "
+        "coords: shard (shard index), index (record index)"
+    ),
     "serve.conn_drop": (
         "HTTP server drops a /classify connection with no response; "
         "coords: request (per-server POST index)"

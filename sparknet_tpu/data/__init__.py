@@ -1,14 +1,28 @@
 """Data layer: the RDD-role ShardedDataset, loaders, preprocessing,
-and the two feed accelerators — ``prefetch`` (device-staging thread)
-and ``pipeline`` (multiprocess host preprocessing, docs/PIPELINE.md).
-Heavy imports stay in the submodules; this package only re-exports the
-names the apps and tools wire together."""
+the packed sharded record format + streaming readers (``records``,
+docs/DATA.md), the cross-job decoded-batch cache (``cache``), and the
+two feed accelerators — ``prefetch`` (device-staging thread +
+double-buffer) and ``pipeline`` (multiprocess host preprocessing,
+docs/PIPELINE.md).  Heavy imports stay in the submodules; this package
+only re-exports the names the apps and tools wire together."""
 
+from .cache import ShmBatchCache, cache_from_args  # noqa: F401
 from .pipeline import (  # noqa: F401
     ParallelBatchPipeline,
     PipelineMetrics,
     default_data_workers,
     resolve_data_workers,
 )
-from .prefetch import maybe_prefetch, prefetch_to_device  # noqa: F401
+from .prefetch import (  # noqa: F401
+    DoubleBuffer,
+    maybe_prefetch,
+    prefetch_to_device,
+)
 from .rdd import BatchIterator, ShardedDataset  # noqa: F401
+from .records import (  # noqa: F401
+    PackedDataset,
+    is_packed,
+    pack_arrays,
+    pack_dataset,
+    packed_dataset,
+)

@@ -133,24 +133,35 @@ class PipelineMetrics:
     next in-order batch (host-bound time); ``worker_wait`` is how long
     producers sat blocked on a free slot (device/consumer-bound —
     healthy backpressure). ``produce`` is the per-batch assembly +
-    transform cost inside a worker."""
+    transform cost inside a worker.  The ``prefetch`` block counts the
+    double-buffering layers (``prefetch_to_device`` staging, the packed
+    readers' shard read-ahead): hits are consumes served from a staged
+    slot, waits are the time blocked on one still in flight.
 
-    def __init__(self):
+    ``source_name`` is the telemetry-registry source this instance
+    registers under: ``"pipeline"`` for the multiprocess pipeline,
+    ``"packed_reader"`` for a serial packed-shard feed — distinct names
+    so a pipeline OVER a packed dataset reports both layers."""
+
+    def __init__(self, source_name: str = "pipeline"):
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self.batches = 0
         self.rows = 0
         self.shm_fallbacks = 0
         self.worker_respawns = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
         self.produce = LatencyHistogram()
         self.worker_wait = LatencyHistogram()
         self.consumer_wait = LatencyHistogram()
+        self.prefetch_wait = LatencyHistogram()
         self.reorder_depth = Gauge()  # batches parked awaiting their turn
         self.slots_free = Gauge()
-        # the telemetry registry's "pipeline" source: the periodic
-        # telemetry: line and bench records see the live pipeline
-        # without extra wiring (weakly held — dies with the pipeline)
-        REGISTRY.register_source("pipeline", self)
+        # the telemetry registry source: the periodic telemetry: line
+        # and bench records see the live feed without extra wiring
+        # (weakly held — dies with the pipeline/reader)
+        REGISTRY.register_source(source_name, self)
 
     # ------------------------------------------------------------- writes
     def record_batch(
@@ -173,6 +184,16 @@ class PipelineMetrics:
         with self._lock:
             self.worker_respawns += 1
 
+    def record_prefetch(self, hit: bool, wait_s: float) -> None:
+        """One double-buffered consume: ``hit`` = served from a staged
+        slot; the wait histogram shows what staging failed to hide."""
+        with self._lock:
+            if hit:
+                self.prefetch_hits += 1
+            else:
+                self.prefetch_misses += 1
+            self.prefetch_wait.observe(wait_s)
+
     # -------------------------------------------------------------- reads
     def snapshot(self) -> dict:
         with self._lock:
@@ -184,6 +205,11 @@ class PipelineMetrics:
                 "rows_per_sec": round(self.rows / dt, 2),
                 "shm_fallbacks": self.shm_fallbacks,
                 "worker_respawns": self.worker_respawns,
+                "prefetch": {
+                    "hits": self.prefetch_hits,
+                    "misses": self.prefetch_misses,
+                    "wait": self.prefetch_wait.snapshot(),
+                },
                 "produce": self.produce.snapshot(),
                 "worker_wait": self.worker_wait.snapshot(),
                 "consumer_wait": self.consumer_wait.snapshot(),

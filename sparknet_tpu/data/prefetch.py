@@ -14,17 +14,29 @@ bitwise-deterministic: the batch sequence is identical to the
 unwrapped iterator's. Not for multi-host global assembly —
 ``make_array_from_process_local_data`` must stay on the main thread
 with identical ordering across processes.
+
+Two double-buffering surfaces live here, both reporting hit/wait
+counts through :class:`~.pipeline.PipelineMetrics` (``prefetch``
+block) instead of being standalone:
+
+- :func:`prefetch_to_device` — the H2D staging thread the apps wrap
+  around every feed;
+- :class:`DoubleBuffer` — a generic one-slot read-ahead the packed
+  shard readers (``data/records.py``) use to open/index the next
+  shard in plan order while the current one is being consumed.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Optional
 
 import jax
 
 _SENTINEL = object()
+_NONE = object()  # DoubleBuffer's "no staged slot" marker (None is a key)
 
 
 def _put_checked(q, stop, item) -> None:
@@ -43,6 +55,7 @@ def prefetch_to_device(
     it: Iterator[Any],
     size: int = 2,
     put: Optional[Callable[[Any], Any]] = None,
+    metrics=None,
 ) -> Iterator[Any]:
     """Yield ``put(next(it))`` with up to ``size`` results staged ahead
     by a worker thread. ``put`` defaults to ``jax.device_put`` (async
@@ -50,7 +63,9 @@ def prefetch_to_device(
     the source iterator re-raise at the consuming ``next()``; closing
     or abandoning the generator stops the worker and releases its
     staged batches (no thread or device memory pinned past the feed's
-    lifetime)."""
+    lifetime).  ``metrics`` (a :class:`~.pipeline.PipelineMetrics`)
+    counts each consume as a prefetch hit (batch already staged) or a
+    wait (consumer blocked on the staging thread)."""
     if size <= 0:
         for b in it:
             yield (put or jax.device_put)(b)
@@ -74,7 +89,15 @@ def prefetch_to_device(
     threading.Thread(target=worker, daemon=True).start()
     try:
         while True:
-            item = q.get()
+            t0 = time.perf_counter()
+            try:
+                item = q.get_nowait()
+                hit = True
+            except queue.Empty:
+                item = q.get()
+                hit = False
+            if metrics is not None:
+                metrics.record_prefetch(hit, time.perf_counter() - t0)
             if (
                 isinstance(item, tuple)
                 and len(item) == 2
@@ -97,8 +120,115 @@ def maybe_prefetch(feed, args, parallel: str):
     """Stage host preprocessing + H2D ahead of the step loop (single
     -process solvers only: multi-host global assembly must stay on the
     main thread; order-preserving, so determinism is unchanged).
-    Shared by every app; ``--prefetch 0`` disables."""
+    Shared by every app; ``--prefetch 0`` disables.  The wrapped feed's
+    own ``PipelineMetrics`` (pipeline or packed reader) absorbs the
+    staging hit/wait counts, so one ``input pipeline:`` line carries
+    the whole host-side story."""
     size = getattr(args, "prefetch", 2)
     if size and parallel == "none" and jax.process_count() == 1:
-        return prefetch_to_device(feed, size=size)
+        return prefetch_to_device(
+            feed, size=size, metrics=getattr(feed, "metrics", None)
+        )
     return feed
+
+
+class DoubleBuffer:
+    """One-slot generic read-ahead: ``get(key)`` returns ``fetch(key)``,
+    served from the slot a prior ``stage(key)`` filled in a background
+    thread when the keys match (a *hit*), fetched synchronously
+    otherwise.  The packed shard readers stage the next shard in plan
+    order while the current one is consumed — the same overlap
+    ``prefetch_to_device`` gives H2D, applied to shard open + index
+    load.  Hits and waits land in the owning ``PipelineMetrics``.
+
+    Threads are spawned per ``stage`` call and are short-lived (one
+    fetch each); a stage that loses the race (consumer skipped past
+    its key, or ``close()``) has its result discarded via ``.close()``
+    when the fetched object supports it.  Exceptions from a staged
+    fetch re-raise at the matching ``get``."""
+
+    def __init__(self, fetch: Callable[[Any], Any], metrics=None):
+        self._fetch = fetch
+        self._metrics = metrics
+        self._cv = threading.Condition()
+        self._staged_key: Any = _NONE
+        self._staged_val: Any = None
+        self._staged_exc: Optional[BaseException] = None
+        self._pending_key: Any = _NONE
+        self._closed = False
+
+    def stage(self, key: Any) -> None:
+        """Start fetching ``key`` in the background (no-op when it is
+        already staged or in flight, or after close)."""
+        with self._cv:
+            if (
+                self._closed
+                or key is None
+                or key == self._staged_key
+                or key == self._pending_key
+            ):
+                return
+            self._pending_key = key
+
+        def run():
+            val, exc = None, None
+            try:
+                val = self._fetch(key)
+            except BaseException as e:  # noqa: BLE001 — re-raised at get
+                exc = e
+            with self._cv:
+                if self._pending_key == key and not self._closed:
+                    self._discard()  # a stale staged slot, if any
+                    self._staged_key = key
+                    self._staged_val, self._staged_exc = val, exc
+                    self._pending_key = _NONE
+                    self._cv.notify_all()
+                    return
+            _close_quietly(val)  # lost the race: release the resource
+
+        threading.Thread(
+            target=run, daemon=True, name="snpk-shard-stage"
+        ).start()
+
+    def get(self, key: Any) -> Any:
+        """``fetch(key)``, from the staged slot when possible."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while self._pending_key == key and not self._closed:
+                self._cv.wait(timeout=0.1)
+            if self._staged_key == key:
+                val, exc = self._staged_val, self._staged_exc
+                self._staged_key, self._staged_val = _NONE, None
+                self._staged_exc = None
+                if self._metrics is not None:
+                    self._metrics.record_prefetch(
+                        True, time.perf_counter() - t0
+                    )
+                if exc is not None:
+                    raise exc
+                return val
+        val = self._fetch(key)
+        if self._metrics is not None:
+            self._metrics.record_prefetch(False, time.perf_counter() - t0)
+        return val
+
+    def _discard(self) -> None:
+        """Release a stale staged value (caller holds the lock)."""
+        if self._staged_key is not _NONE and self._staged_exc is None:
+            _close_quietly(self._staged_val)
+        self._staged_key, self._staged_val = _NONE, None
+        self._staged_exc = None
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._discard()
+            self._pending_key = _NONE
+            self._cv.notify_all()
+
+
+def _close_quietly(val: Any) -> None:
+    try:
+        getattr(val, "close", lambda: None)()
+    except Exception:
+        pass

@@ -30,6 +30,14 @@ on a multi-host run rank 0 additionally prints the cluster-merged
 phase table with per-rank skew from the heartbeat telemetry piggyback,
 and the anomaly detectors emit ``anomaly:`` JSON lines on stragglers,
 step/loss spikes, and queue stalls).
+Data-plane knobs pass through as well (docs/DATA.md):
+``--data-format=packed`` streams a ``sparknet-pack`` output under
+``--data-dir`` (CRC-checked shard records, seeded global shuffle,
+shard-level O(1) resume) and ``--data-cache[=NS]`` attaches the
+cross-job decoded-batch cache — a second co-located run of the same
+stream reads decoded batches from named shared memory instead of
+re-decoding every epoch, bit-identically (the run prints a
+``data cache:`` hit/miss/evict line on exit).
 ``time`` routes to tools/time_net; ``test`` builds the
 TEST-phase net and reports averaged metrics.  Both ``--flag=value``
 and ``--flag value`` spellings are accepted, like the original binary.

@@ -1,0 +1,117 @@
+"""``sparknet-pack`` — convert data sources into packed shard files.
+
+The writer half of the packed data plane (docs/DATA.md): reads any of
+the repo's existing sources through their normal loaders and writes a
+packed dataset directory — ``train/`` (+ ``test/`` when the source has
+one) of CRC-checked shard files with index footers, a ``MANIFEST.json``
+carrying the content fingerprint the decoded-batch cache keys on, and
+the per-pixel ``mean.npy`` the apps' ``transform_param`` fallback needs
+(computed once at pack time; regenerating it at train time would
+defeat streaming).
+
+    sparknet-pack --source cifar --data-dir ~/cifar10 --out ~/packed
+    sparknet-pack --source synthetic-cifar --n 10000 --out /tmp/packed
+    sparknet-pack --source imagenet --data-dir ~/imagenet --out ~/packed
+    sparknet-pack --source lmdb --data-dir train_lmdb --out ~/packed
+    python -m sparknet_tpu.tools.pack_records ...   # same thing
+
+Shards mirror the source's partitioning one-to-one (``--parts`` for
+array-backed sources), which is what makes the packed full-shuffle
+stream bit-identical to the legacy in-memory feed — switching
+``--data-format`` can never change training results (pinned by test
+and by the scripts/check.sh data-plane smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _pack_split(ds, out_dir, mean=None, meta=None):
+    from ..data.records import pack_dataset
+
+    t0 = time.time()  # one-shot CLI wall time, not a metric
+    manifest = pack_dataset(ds, out_dir, mean=mean, meta=meta)
+    return {
+        "dir": out_dir,
+        "records": manifest["record_count"],
+        "shards": len(manifest["shards"]),
+        "bytes": sum(s["bytes"] for s in manifest["shards"]),
+        "fingerprint": manifest["fingerprint"],
+        "seconds": round(time.time() - t0, 2),
+    }
+
+
+def main(argv=None) -> int:
+    import os
+
+    ap = argparse.ArgumentParser(
+        prog="sparknet-pack",
+        description="convert cifar/imagenet/lmdb/synthetic sources into "
+                    "the packed sharded record format (docs/DATA.md)",
+    )
+    ap.add_argument("--source", required=True,
+                    choices=("cifar", "synthetic-cifar", "imagenet",
+                             "synthetic-imagenet", "lmdb"))
+    ap.add_argument("--data-dir", default=None,
+                    help="source location (cifar/imagenet layouts, or an "
+                         "LMDB dir/file); synthetic sources ignore it")
+    ap.add_argument("--out", required=True, help="output dataset dir")
+    ap.add_argument("--parts", type=int, default=8,
+                    help="partitions -> shards for array-backed sources "
+                         "(default 8, matching the apps' loaders — keep "
+                         "it to preserve legacy-feed bit-identity)")
+    ap.add_argument("--n", type=int, default=10000,
+                    help="synthetic sources: training record count (test "
+                         "split sizes follow the loaders' rules)")
+    args = ap.parse_args(argv)
+
+    src = args.source
+    data_dir = None if src.startswith("synthetic") else args.data_dir
+    meta = {"source": src, "packed_at": int(time.time())}
+    out = []
+    if src in ("cifar", "synthetic-cifar"):
+        from ..data.cifar import cifar10_dataset
+
+        train_ds, mean = cifar10_dataset(
+            data_dir, train=True, num_partitions=args.parts,
+            synthetic_n=args.n,
+        )
+        test_ds, _ = cifar10_dataset(
+            data_dir, train=False, num_partitions=args.parts,
+            synthetic_n=args.n,
+        )
+        out.append(_pack_split(
+            train_ds, os.path.join(args.out, "train"), mean=mean, meta=meta
+        ))
+        out.append(_pack_split(
+            test_ds, os.path.join(args.out, "test"), mean=mean, meta=meta
+        ))
+    elif src in ("imagenet", "synthetic-imagenet"):
+        from ..data.imagenet import imagenet_dataset
+
+        train_ds = imagenet_dataset(data_dir, train=True, synthetic_n=args.n)
+        test_ds = imagenet_dataset(data_dir, train=False, synthetic_n=args.n)
+        out.append(_pack_split(
+            train_ds, os.path.join(args.out, "train"), meta=meta
+        ))
+        out.append(_pack_split(
+            test_ds, os.path.join(args.out, "test"), meta=meta
+        ))
+    else:  # lmdb: one DB = one split
+        if not args.data_dir:
+            ap.error("--source lmdb requires --data-dir")
+        from ..data.caffe_layers import lmdb_dataset
+
+        ds = lmdb_dataset(args.data_dir, num_partitions=args.parts)
+        out.append(_pack_split(
+            ds, os.path.join(args.out, "train"), meta=meta
+        ))
+    print(json.dumps({"packed": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

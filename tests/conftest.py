@@ -77,10 +77,17 @@ def assert_no_pipeline_leaks():
     respawns would pass a naive check) — and no shared-memory slot may
     survive in /dev/shm, including the replacement slots respawns add
     (``..._r{n}`` names).  data/pipeline.py names everything with the
-    SHM_PREFIX, so stray ones are attributable."""
+    SHM_PREFIX, so stray ones are attributable.
+
+    The cross-job decoded-batch cache (data/cache.py) persists named
+    ``{SHM_CACHE_PREFIX}_*`` segments ON PURPOSE across jobs — but a
+    test run is a closed world: every test that opens a cache namespace
+    must ``clear()`` it, and any segment that survives the session is
+    an orphan this fixture names."""
     yield
     import re
 
+    from sparknet_tpu.data.cache import SHM_CACHE_PREFIX
     from sparknet_tpu.data.pipeline import SHM_PREFIX
 
     stray = [
@@ -95,3 +102,8 @@ def assert_no_pipeline_leaks():
     if os.path.isdir("/dev/shm"):
         segs = glob.glob(f"/dev/shm/{SHM_PREFIX}_*")
         assert not segs, f"shared-memory segments leaked past tests: {segs}"
+        cache_segs = glob.glob(f"/dev/shm/{SHM_CACHE_PREFIX}_*")
+        assert not cache_segs, (
+            f"decoded-batch cache segments leaked past tests (a test "
+            f"opened a cache namespace without clear()): {cache_segs}"
+        )

@@ -1,0 +1,500 @@
+"""data/records.py + data/cache.py: the packed data plane's contracts.
+
+The acceptance spine (ISSUE 8): the packed streaming readers are
+bit-identical to the legacy in-memory ``ShardedDataset`` feed (so
+``--data-format packed`` can never change training results), ``skip(n)``
+extends PR 2's O(1) resume to the shard level (equal to
+iterate-then-slice, across shard boundaries, under 0/2/4 pipeline
+workers), the global shuffle is deterministic per ``(seed, epoch)``,
+and the cross-job decoded-batch cache serves bit-identical batches —
+including after torn segments, evictions, and ``data.torn_shard``
+chaos, none of which may poison it.  Every cache namespace opened here
+is cleared; the session leak fixture asserts no ``snkc_*`` shm segment
+survives the suite.
+"""
+
+import glob
+import json
+import multiprocessing
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.cache import SHM_CACHE_PREFIX, ShmBatchCache
+from sparknet_tpu.data.records import (
+    PackedDataset,
+    PackedShardReader,
+    ShardError,
+    decode_record,
+    encode_record,
+    pack_arrays,
+    packed_dataset,
+)
+from sparknet_tpu.data.rdd import ShardedDataset
+
+
+def _arrays(n=97, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "data": rng.integers(0, 255, (n, 8, 8, 3)).astype(np.uint8),
+        "label": np.arange(n, dtype=np.int32),
+    }
+
+
+def _aug(batch, r):
+    return {
+        "data": batch["data"].astype(np.float32)
+        + r.normal(size=batch["data"].shape).astype(np.float32),
+        "label": batch["label"],
+    }
+
+
+def _assert_same_stream(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert sorted(x) == sorted(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+
+
+@pytest.fixture
+def packed_dir(tmp_path):
+    arrays = _arrays()
+    d = str(tmp_path / "packed")
+    pack_arrays(d, arrays, 4)
+    return d, arrays
+
+
+@pytest.fixture
+def cache():
+    c = ShmBatchCache(f"t-{uuid.uuid4().hex[:8]}", max_bytes=32_000_000)
+    yield c
+    c.clear()
+    assert not glob.glob(f"/dev/shm/{SHM_CACHE_PREFIX}_{c._ns}_*")
+
+
+# ---------------------------------------------------------------------------
+# format layer
+# ---------------------------------------------------------------------------
+
+def test_record_codec_roundtrip():
+    sample = {
+        "data": np.arange(24, dtype=np.uint8).reshape(2, 4, 3),
+        "label": np.asarray(np.int32(7)),  # 0-d labels must stay 0-d
+        "weight": np.asarray([1.5, -2.0], np.float32),
+    }
+    cache = {}
+    payload = encode_record(sample)
+    for _ in range(2):  # second pass exercises the header cache
+        out = decode_record(payload, cache)
+        assert sorted(out) == sorted(sample)
+        for k in sample:
+            assert out[k].shape == np.asarray(sample[k]).shape
+            np.testing.assert_array_equal(out[k], sample[k])
+
+
+def test_shard_roundtrip_index_and_torn_trailer(tmp_path, packed_dir):
+    d, arrays = packed_dir
+    manifest = json.load(open(os.path.join(d, "MANIFEST.json")))
+    assert manifest["record_count"] == len(arrays["label"])
+    shard0 = manifest["shards"][0]
+    r = PackedShardReader(os.path.join(d, shard0["file"]))
+    assert len(r) == shard0["records"]
+    rec = r.record(3)
+    np.testing.assert_array_equal(rec["data"], arrays["data"][3])
+    assert int(rec["label"]) == 3
+    # the bulk fast path: uniform layout + verified region checksum
+    assert r.region_sum() == int(shard0["region_sum"])
+    mat, cols = r.uniform_matrix()
+    assert mat.shape[0] == len(r)
+    r.close()
+    # a truncated shard (torn trailer) must fail loudly at open
+    path = str(tmp_path / "torn.snpk")
+    with open(os.path.join(d, shard0["file"]), "rb") as fh:
+        blob = fh.read()
+    with open(path, "wb") as fh:
+        fh.write(blob[: len(blob) - 7])
+    with pytest.raises(ShardError):
+        PackedShardReader(path)
+
+
+def test_crc_failing_record_skipped_with_counter(tmp_path):
+    from sparknet_tpu.telemetry.registry import REGISTRY
+
+    d = str(tmp_path / "p")
+    pack_arrays(d, _arrays(20), 1)
+    ds = PackedDataset(d)
+    manifest = json.load(open(os.path.join(d, "MANIFEST.json")))
+    # flip one byte inside record 5's payload: region checksum breaks
+    # (bulk path refuses the shard) and record 5's CRC fails (the
+    # per-record path skips it with a counter and substitutes a
+    # healthy neighbor — shapes hold, the stream keeps going)
+    path = os.path.join(d, manifest["shards"][0]["file"])
+    r = PackedShardReader(path)
+    off = int(r.offsets[5]) + 8 + 40
+    r.close()
+    blob = bytearray(open(path, "rb").read())
+    blob[off] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    before = REGISTRY.counter("packed_reader", event="crc_skipped").snapshot()
+    got = list(ds.batches(5, shuffle=False, epochs=1))
+    after = REGISTRY.counter("packed_reader", event="crc_skipped").snapshot()
+    assert after - before == 1
+    assert len(got) == 4 and all(len(b["label"]) == 5 for b in got)
+
+
+# ---------------------------------------------------------------------------
+# streaming readers: legacy equivalence, shuffle, skip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("drop_remainder", [True, False])
+def test_packed_stream_bit_identical_to_legacy(packed_dir, drop_remainder):
+    d, arrays = packed_dir
+    legacy = ShardedDataset.from_arrays(arrays, 4)
+    pds = PackedDataset(d)
+    a = list(legacy.batches(8, shuffle=True, seed=3, epochs=2,
+                            drop_remainder=drop_remainder, transform=_aug))
+    b = list(pds.batches(8, shuffle=True, seed=3, epochs=2,
+                         drop_remainder=drop_remainder, transform=_aug))
+    _assert_same_stream(a, b)
+
+
+@pytest.mark.parametrize("workers", [0, 2, 4])
+def test_skip_across_shards_equals_iterate_then_slice(packed_dir, workers):
+    """Shard-level O(1) resume: skip(13) crosses shard boundaries (4
+    shards x ~24 records, batches of 8) and must equal slicing the
+    uninterrupted stream — serially and through the multiprocess
+    pipeline (whose pre-start skip offsets every worker)."""
+    d, _ = packed_dir
+    pds = PackedDataset(d)
+    ref = list(pds.batches(8, shuffle=True, seed=3, epochs=2,
+                           transform=_aug))[13:18]
+    if workers == 0:
+        it = pds.batches(8, shuffle=True, seed=3, epochs=2, transform=_aug)
+        it.skip(13)
+        got = [next(it) for _ in range(5)]
+        it.close()
+    else:
+        from sparknet_tpu.data.pipeline import ParallelBatchPipeline
+
+        with ParallelBatchPipeline(
+            pds, 8, workers=workers, shuffle=True, seed=3, epochs=2,
+            transform=_aug,
+        ) as pipe:
+            pipe.skip(13)
+            got = [next(pipe) for _ in range(5)]
+    _assert_same_stream(ref, got)
+
+
+def test_global_shuffle_deterministic_per_seed_epoch(packed_dir):
+    d, _ = packed_dir
+    for window in (0, 16):  # full mode and streaming-window mode
+        pds = PackedDataset(d, shuffle_window=window)
+        one = [b["label"] for b in pds.batches(8, shuffle=True, seed=5,
+                                               epochs=2)]
+        two = [b["label"] for b in pds.batches(8, shuffle=True, seed=5,
+                                               epochs=2)]
+        for x, y in zip(one, two):
+            np.testing.assert_array_equal(x, y)
+        other = [b["label"] for b in pds.batches(8, shuffle=True, seed=6,
+                                                 epochs=2)]
+        assert any((x != y).any() for x, y in zip(one, other))
+        # epochs reshuffle (epoch is part of the RNG key)
+        per_epoch = np.array_split(np.concatenate(one), 2)
+        assert (per_epoch[0] != per_epoch[1]).any()
+        # every record appears exactly once per epoch
+        seen = np.sort(np.concatenate(
+            [b["label"] for b in pds.batches(8, shuffle=True, seed=5,
+                                             epochs=1,
+                                             drop_remainder=False)]
+        ))
+        np.testing.assert_array_equal(seen, np.arange(97))
+
+
+def test_host_shard_partitions_records(packed_dir):
+    d, _ = packed_dir
+    pds = PackedDataset(d)
+    s0, s1 = pds.shard(0, 2), pds.shard(1, 2)
+    assert s0.num_records + s1.num_records == pds.num_records
+    assert {s0.fingerprint, s1.fingerprint, pds.fingerprint}.__len__() == 3
+    got = np.sort(np.concatenate(
+        [b["label"] for s in (s0, s1)
+         for b in s.batches(8, shuffle=False, epochs=1,
+                            drop_remainder=False)]
+    ))
+    np.testing.assert_array_equal(got, np.arange(97))
+
+
+# ---------------------------------------------------------------------------
+# decoded-batch cache
+# ---------------------------------------------------------------------------
+
+def test_cache_hits_are_bit_identical(packed_dir, cache):
+    d, _ = packed_dir
+    pds = PackedDataset(d, cache=cache)
+    cold = list(pds.batches(8, shuffle=True, seed=3, epochs=1,
+                            transform=_aug))
+    snap = cache.metrics.snapshot()
+    assert snap["puts"] == len(cold) and snap["hits"] == 0
+    warm = list(pds.batches(8, shuffle=True, seed=3, epochs=1,
+                            transform=_aug))
+    assert cache.metrics.snapshot()["hits"] == len(warm)
+    _assert_same_stream(cold, warm)
+    # a different stream (other seed) shares nothing
+    list(pds.batches(8, shuffle=True, seed=4, epochs=1))
+    assert cache.metrics.snapshot()["hits"] == len(warm)
+
+
+def test_cache_cross_process(packed_dir, cache):
+    """The cross-job story: a forked child (a co-located job) fills the
+    cache; the parent's fresh reader serves from it."""
+    d, _ = packed_dir
+
+    def child():
+        pds = PackedDataset(d, cache=ShmBatchCache(
+            cache.namespace, max_bytes=cache.max_bytes
+        ))
+        list(pds.batches(8, shuffle=True, seed=3, epochs=1))
+
+    p = multiprocessing.get_context("fork").Process(target=child)
+    p.start()
+    p.join(timeout=60)
+    assert p.exitcode == 0
+    pds = PackedDataset(d, cache=cache)
+    got = list(pds.batches(8, shuffle=True, seed=3, epochs=1))
+    snap = cache.metrics.snapshot()
+    assert snap["hits"] == len(got) and snap["puts"] == 0
+    ref = list(PackedDataset(d).batches(8, shuffle=True, seed=3, epochs=1))
+    _assert_same_stream(ref, got)
+
+
+def test_cache_eviction_respects_budget(packed_dir):
+    d, _ = packed_dir
+    c = ShmBatchCache(f"t-{uuid.uuid4().hex[:8]}", max_bytes=3 * 4096)
+    try:
+        pds = PackedDataset(d, cache=c)
+        list(pds.batches(8, shuffle=True, seed=3, epochs=1))
+        snap = c.metrics.snapshot()
+        assert snap["evictions"] > 0
+        assert c.total_bytes() <= c.max_bytes
+    finally:
+        c.clear()
+
+
+def test_torn_cache_segment_falls_back_to_decode(packed_dir, cache):
+    from multiprocessing import shared_memory
+
+    d, _ = packed_dir
+    pds = PackedDataset(d, cache=cache)
+    cold = list(pds.batches(8, shuffle=True, seed=3, epochs=1))
+    seg = glob.glob(f"/dev/shm/{SHM_CACHE_PREFIX}_{cache._ns}_*")[0]
+    s = shared_memory.SharedMemory(name=os.path.basename(seg))
+    s.buf[s.size - 1] = (s.buf[s.size - 1] + 1) % 256  # payload bit rot
+    s.close()
+    warm = list(pds.batches(8, shuffle=True, seed=3, epochs=1))
+    snap = cache.metrics.snapshot()
+    assert snap["torn"] == 1  # detected, unlinked, re-decoded
+    _assert_same_stream(cold, warm)
+
+
+def test_chaos_torn_shard_never_poisons_cache(packed_dir, cache):
+    from sparknet_tpu import chaos
+    from sparknet_tpu.telemetry.registry import REGISTRY
+
+    d, _ = packed_dir
+    clean = list(PackedDataset(d).batches(8, shuffle=False, epochs=1))
+    before = REGISTRY.counter("packed_reader", event="crc_skipped").snapshot()
+    chaos.install("data.torn_shard@shard=1:index=2")
+    try:
+        pds = PackedDataset(d, cache=cache)
+        got = list(pds.batches(8, shuffle=False, epochs=1))
+        after = REGISTRY.counter(
+            "packed_reader", event="crc_skipped"
+        ).snapshot()
+        assert after - before == 1
+        # the tainted batch (duplicated neighbor record) was NOT cached
+        assert cache.metrics.snapshot()["puts"] == len(got) - 1
+        assert sum(
+            (x["label"] != y["label"]).any() for x, y in zip(clean, got)
+        ) == 1
+    finally:
+        chaos.clear()
+    # chaos off: the stream is clean again — nothing stale in the cache
+    got2 = list(PackedDataset(d, cache=cache).batches(8, shuffle=False,
+                                                      epochs=1))
+    _assert_same_stream(clean, got2)
+
+
+# ---------------------------------------------------------------------------
+# training: bitwise equality + shard-level mid-epoch resume
+# ---------------------------------------------------------------------------
+
+_NET = """
+name: "dp"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 3
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+_SOLVER = 'base_lr: 0.1\nlr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 6\n'
+
+
+def _mlp_solver():
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    sp = caffe_pb.load_solver(_SOLVER, is_path=False)
+    sp.net_param = caffe_pb.load_net(_NET, is_path=False)
+    return Solver(sp, {"data": (8, 6), "label": (8,)})
+
+
+def _mlp_arrays():
+    rng = np.random.default_rng(11)
+    return {
+        "data": rng.normal(size=(48, 6)).astype(np.float32),
+        "label": rng.integers(0, 3, 48).astype(np.int32),
+    }
+
+
+def test_training_bitwise_equal_and_midepoch_resume(tmp_path, cache):
+    """Weights after training on the packed feed — cold, cache-served,
+    and after a mid-epoch save/restore whose align_feed fast-forwards
+    via the shard-level skip — are all bitwise equal to the legacy
+    in-memory feed's."""
+    import jax
+
+    arrays = _mlp_arrays()
+    d = str(tmp_path / "p")
+    pack_arrays(d, arrays, 3)
+    legacy = ShardedDataset.from_arrays(arrays, 3)
+    pds_cold = PackedDataset(d, cache=cache)
+
+    def train(feed):
+        s = _mlp_solver()
+        s.step(feed, 6)
+        return jax.device_get(s.params)
+
+    ref = train(legacy.batches(8, shuffle=True, seed=5))
+    results = {
+        "packed_cold": train(pds_cold.batches(8, shuffle=True, seed=5)),
+        "packed_cached": train(pds_cold.batches(8, shuffle=True, seed=5)),
+    }
+    assert cache.metrics.snapshot()["hits"] >= 6
+
+    # mid-epoch resume: 3 iters, snapshot, fresh solver + fresh feed,
+    # restore aligns the feed (skip crosses a shard boundary), 3 more
+    path = str(tmp_path / "ck.solverstate.npz")
+    s1 = _mlp_solver()
+    feed = pds_cold.batches(8, shuffle=True, seed=5)
+    s1.step(feed, 3)
+    s1.save(path)
+    s2 = _mlp_solver()
+    feed2 = pds_cold.batches(8, shuffle=True, seed=5)
+    s2.restore(path, feed2)
+    assert s2.iter == 3
+    s2.step(feed2, 3)
+    results["resumed"] = jax.device_get(s2.params)
+
+    for name, got in results.items():
+        for layer in ref:
+            for p in ref[layer]:
+                np.testing.assert_array_equal(
+                    ref[layer][p], got[layer][p],
+                    err_msg=f"{name}: {layer}/{p}",
+                )
+
+
+# ---------------------------------------------------------------------------
+# prefetch double-buffering + metrics surface
+# ---------------------------------------------------------------------------
+
+def test_reader_metrics_and_prefetch_counts(packed_dir):
+    d, _ = packed_dir
+    pds = PackedDataset(d)
+    it = pds.batches(8, shuffle=True, seed=3, epochs=1)
+    n = len(list(it))
+    snap = it.metrics.snapshot()
+    it.close()
+    assert snap["batches"] == n and snap["rows"] == n * 8
+    pf = snap["prefetch"]
+    assert pf["hits"] + pf["misses"] >= 1  # shard opens went through it
+    assert set(pf["wait"]) == {"count", "mean_ms", "p50_ms", "p95_ms",
+                               "p99_ms"}
+
+
+def test_double_buffer_hit_miss_and_errors():
+    import time
+
+    from sparknet_tpu.data.pipeline import PipelineMetrics
+    from sparknet_tpu.data.prefetch import DoubleBuffer
+
+    pm = PipelineMetrics(source_name="test_dbuf")
+    calls = []
+
+    def fetch(k):
+        calls.append(k)
+        if k == "boom":
+            raise RuntimeError("staged failure")
+        return f"v{k}"
+
+    db = DoubleBuffer(fetch, metrics=pm)
+    assert db.get(1) == "v1"          # nothing staged: miss
+    db.stage(2)
+    for _ in range(100):              # staged in a background thread
+        time.sleep(0.01)
+        if pm.prefetch_hits + pm.prefetch_misses >= 1 and 2 in calls:
+            break
+    assert db.get(2) == "v2"          # hit
+    assert pm.prefetch_hits == 1 and pm.prefetch_misses == 1
+    db.stage("boom")
+    with pytest.raises(RuntimeError, match="staged failure"):
+        db.get("boom")                # staged exception re-raises at get
+    db.close()
+
+
+def test_prefetch_to_device_reports_metrics(packed_dir):
+    from sparknet_tpu.data.pipeline import PipelineMetrics
+    from sparknet_tpu.data.prefetch import prefetch_to_device
+
+    d, _ = packed_dir
+    pds = PackedDataset(d)
+    inner = pds.batches(8, shuffle=True, seed=0, epochs=1)
+    pm = inner.metrics
+    base = pm.prefetch_hits + pm.prefetch_misses
+    feed = prefetch_to_device(inner, size=2, put=lambda b: b, metrics=pm)
+    n = len(list(feed))
+    assert n == 12
+    assert pm.prefetch_hits + pm.prefetch_misses >= base + n
+    inner.close()
+
+
+# ---------------------------------------------------------------------------
+# pack tool
+# ---------------------------------------------------------------------------
+
+def test_pack_tool_cli_roundtrip(tmp_path, capsys):
+    from sparknet_tpu.tools import pack_records
+
+    out = str(tmp_path / "out")
+    rc = pack_records.main(
+        ["--source", "synthetic-cifar", "--n", "64", "--out", out]
+    )
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [s["records"] for s in rec["packed"]] == [64, 12]
+    train = packed_dataset(out, train=True)
+    assert train.num_records == 64
+    assert train.sample_shape() == (32, 32, 3)
+    assert train.mean() is not None and train.mean().shape == (32, 32, 3)
+    # bit-identical to the loader it packed from
+    from sparknet_tpu.data.cifar import cifar10_dataset
+
+    legacy, _ = cifar10_dataset(None, train=True, synthetic_n=64)
+    _assert_same_stream(
+        list(legacy.batches(8, shuffle=True, seed=1, epochs=1)),
+        list(train.batches(8, shuffle=True, seed=1, epochs=1)),
+    )
