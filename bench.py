@@ -28,6 +28,13 @@ Env knobs:
                              cached, one epoch each on a synthetic
                              CIFAR feed — the decode-skip speedup is
                              host-only and valid on 1 CPU
+  BENCH_MODEL=serving_tier   serving-tier SLO bench (PR 9): continuous
+                             vs fill-then-flush batching p50/p99 at
+                             equal offered load, then a 2-replica
+                             router e2e — loadgen through replica kill
+                             + rolling hot-swap (zero failed requests
+                             is the bar) and the persistent compile
+                             cache's warm-restart warmup cut
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
   BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
@@ -649,6 +656,196 @@ def bench_data_plane(platform: str) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serving_tier(platform: str) -> dict:
+    """Serving-tier SLO bench (``BENCH_MODEL=serving_tier``).
+
+    Three measurements, one record:
+
+    1. **Continuous vs fill-then-flush** (in-process, equal offered
+       load): the same engine + closed-loop generator, one arm per
+       batcher mode.  Fill waits out the co-rider window under
+       non-saturating mixed load; the continuous admitter dispatches
+       when the arrival-rate EWMA says a bigger bucket is unreachable
+       — p99 (and p50) should drop at the same offered rate.
+    2. **Chaos e2e** (subprocess): a 2-replica router tier takes a
+       loadgen burst while one replica is SIGKILLed and a rolling
+       hot-swap lands; the bar is ZERO failed requests and both
+       generations observed in responses.
+    3. **Warm-restart warmup**: the respawned replica boots against
+       the compile cache its predecessor populated — warmup_s cold vs
+       warm (acceptance: >= 30% cut).
+
+    All numbers are CPU-meaningful: latency ratios and warmup cuts,
+    not absolute throughput."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from sparknet_tpu.serve.batcher import MicroBatcher
+    from sparknet_tpu.serve.engine import InferenceEngine
+    from sparknet_tpu.serve.loadgen import run_http_loadgen, run_loadgen
+    from sparknet_tpu.serve.metrics import ServeMetrics
+    from sparknet_tpu.serve.server import Client
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    deploy = os.path.join(zoo, "cifar10_quick_deploy.prototxt")
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 240))
+    sizes = (1, 2, 5, 8, 3)
+    concurrency = 4
+    buckets = (1, 8, 32)
+
+    # ---- arm 1: batching-policy A/B at equal offered load
+    engine = InferenceEngine.from_files(deploy, buckets=buckets)
+    engine.warmup()
+    arms = {}
+    for mode in ("fill", "continuous"):
+        metrics = ServeMetrics(buckets)
+        engine.metrics = metrics
+        batcher = MicroBatcher(
+            engine, metrics=metrics, mode=mode, max_latency_us=20_000
+        )
+        rec = run_loadgen(
+            engine, n_requests=n_req, sizes=sizes,
+            concurrency=concurrency, batcher=batcher, metrics=metrics,
+        )
+        batcher.drain()
+        arms[mode] = {
+            k: rec[k] for k in
+            ("value", "p50_ms", "p95_ms", "p99_ms", "errors")
+        }
+    p99_fill = arms["fill"]["p99_ms"] or 1e-9
+    p99_cont = arms["continuous"]["p99_ms"] or 1e-9
+
+    # ---- arms 2+3: the replicated tier under kill + hot-swap chaos
+    tmp = tempfile.mkdtemp(prefix="bench_serving_tier_")
+    proc = None
+    try:
+        from sparknet_tpu.solver import snapshot as snap
+
+        weights0 = os.path.join(tmp, "w_iter_10.solverstate.npz")
+        weights1 = os.path.join(tmp, "w_iter_20.solverstate.npz")
+        host_params = jax.device_get(engine.params)
+        host_state = jax.device_get(engine.state)
+        snap.save_state(weights0, params=host_params, state=host_state)
+        snap.save_state(weights1, params=host_params, state=host_state)
+
+        cache_root = os.path.join(tmp, "compile_cache")
+        portfile = os.path.join(tmp, "router.json")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sparknet_tpu.tools.serve",
+             "--model", deploy, "--weights", weights0,
+             "--replicas", "2", "--port", "0",
+             "--buckets", ",".join(str(b) for b in buckets),
+             "--portfile", portfile,
+             "--run-dir", os.path.join(tmp, "run"),
+             "--compile-cache", cache_root],
+            cwd=_HERE,
+        )
+        deadline = time.time() + 600
+        while not os.path.exists(portfile):
+            if proc.poll() is not None or time.time() > deadline:
+                raise RuntimeError("serving tier failed to start")
+            time.sleep(0.2)
+        doc = json.load(open(portfile))
+        client = Client(doc["host"], doc["port"], timeout=60, retries=4)
+        while True:
+            try:
+                _, hz = client.healthz()
+                if hz.get("replicas_healthy") == 2:
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError("replicas never became healthy")
+            time.sleep(0.3)
+        cold_warmup = max(
+            r["warmup_s"] for r in hz["replicas"]
+            if r["warmup_s"] is not None
+        )
+        victim_pid = hz["replicas"][0]["pid"]
+
+        # loadgen in a thread; kill + roll land mid-burst
+        import threading
+
+        result = {}
+
+        def drive():
+            result["loadgen"] = run_http_loadgen(
+                doc["host"], doc["port"], (32, 32, 3),
+                n_requests=n_req, sizes=sizes, concurrency=concurrency,
+            )
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        time.sleep(1.0)
+        os.kill(victim_pid, signal.SIGKILL)   # the replica-kill scenario
+        time.sleep(1.0)
+        _, roll = client.reload(weights1)      # the rolling hot-swap
+        t.join(600)
+        lg = result.get("loadgen") or {}
+
+        # warm-restart warmup: wait for the respawned replica
+        while True:
+            _, hz = client.healthz()
+            if hz.get("replicas_healthy") == 2 and all(
+                r["pid"] is not None for r in hz["replicas"]
+            ) and hz["replicas"][0]["pid"] != victim_pid:
+                break
+            if time.time() > deadline:
+                raise RuntimeError("victim replica never respawned")
+            time.sleep(0.3)
+        warm_warmup = hz["replicas"][0]["warmup_s"]
+        _, tier_metrics = client.metrics()
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        proc = None
+
+        speedup = (
+            round(cold_warmup / warm_warmup, 3)
+            if warm_warmup else None
+        )
+        return {
+            "metric": "serving_tier_p99_ms_continuous",
+            "value": p99_cont,
+            "unit": "ms",
+            "vs_baseline": None,
+            "platform": platform,
+            "requests_per_arm": n_req,
+            "sizes": list(sizes),
+            "concurrency": concurrency,
+            "buckets": list(buckets),
+            "batching": arms,
+            # >1.0 = continuous beats fill at the same offered load
+            "p99_improvement": round(p99_fill / p99_cont, 3),
+            "p50_ms": arms["continuous"]["p50_ms"],
+            "p99_ms": arms["continuous"]["p99_ms"],
+            "tier": {
+                "replicas": 2,
+                "failed_requests": lg.get("failed_requests"),
+                "served_generations": lg.get("served_generations"),
+                "loadgen": lg,
+                "roll": roll,
+                "router": (tier_metrics or {}).get("router"),
+            },
+            "cold_warmup_s": cold_warmup,
+            "warm_warmup_s": warm_warmup,
+            "warm_restart_speedup": speedup,
+            "warmup_cut_pct": (
+                round(100 * (1 - warm_warmup / cold_warmup), 1)
+                if warm_warmup and cold_warmup else None
+            ),
+            "host_cpus": os.cpu_count(),
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_comm(platform: str) -> dict:
     """Communication-layer A/B (``BENCH_MODEL=comm``): τ-local-SGD
     rounds of cifar10_quick on a dp mesh, one arm per comm config.
@@ -854,6 +1051,8 @@ def main() -> None:
         runner = bench_input_pipeline
     elif mode == "data_plane":
         runner = bench_data_plane
+    elif mode == "serving_tier":
+        runner = bench_serving_tier
     elif mode in IMAGENET_ARCHS:
         runner = functools.partial(bench_imagenet, arch=mode)
     else:
@@ -861,7 +1060,7 @@ def main() -> None:
         # Exception and still emits the JSON error record
         raise ValueError(
             f"BENCH_MODEL={mode!r}: want "
-            f"bert|input_pipeline|data_plane|comm|"
+            f"bert|input_pipeline|data_plane|comm|serving_tier|"
             f"{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
@@ -903,6 +1102,8 @@ if __name__ == "__main__":
                         if mode == "comm"
                         else "data_plane_cached_rows_per_sec"
                         if mode == "data_plane"
+                        else "serving_tier_p99_ms_continuous"
+                        if mode == "serving_tier"
                         else f"{mode}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,

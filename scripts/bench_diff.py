@@ -130,6 +130,36 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
             drop = (a - b) / a
             add(key, a, b, "",
                 drop > args.throughput_pct / 100.0, f"{-drop:+.1%}")
+    # serving records (loadgen / BENCH_MODEL=serving_tier): end-to-end
+    # request latency, lower is better (top-level keys only — nested
+    # per-arm copies would double-report)
+    for key in ("p50_ms", "p99_ms"):
+        a, b = old.get(key), new.get(key)
+        if a and b:
+            rise = (b - a) / a
+            add(key, a, b, "", rise > args.throughput_pct / 100.0,
+                f"{rise:+.1%}")
+    # ratio fields, higher is better: continuous-vs-fill p99 win and
+    # the compile cache's warm-restart warmup speedup
+    for key in ("p99_improvement", "warm_restart_speedup"):
+        a, b = find_key(old, key), find_key(new, key)
+        if a and b:
+            drop = (a - b) / a
+            add(key, a, b, "",
+                drop > args.throughput_pct / 100.0, f"{-drop:+.1%}")
+    # the chaos bar is absolute: any failed request regresses
+    a, b = find_key(old, "failed_requests"), find_key(new, "failed_requests")
+    if b is not None:
+        add("failed_requests", a, b, "", bool(b),
+            "ZERO is the bar" if b else "ok")
+    # served-generation coverage (hot-swap observability): count of
+    # distinct generations answered during the run — informational
+    gens_old = (old.get("tier") or {}).get("served_generations")
+    gens_new = (new.get("tier") or {}).get("served_generations")
+    if gens_new is not None:
+        add("served_generations",
+            float(len(gens_old)) if gens_old is not None else None,
+            float(len(gens_new)), "", False, str(gens_new))
 
     if not rows:
         print("bench_diff: no comparable fields between the two records")

@@ -250,6 +250,19 @@ else
   exit 1
 fi
 
+# ---- serving-tier smoke (ISSUE 9): 2 subprocess engine replicas behind
+# the router take a closed-loop HTTP burst while one replica is
+# SIGKILLed and a rolling hot-swap to a new verified solverstate lands —
+# zero failed requests, both generations served, and the respawned
+# replica must boot off the persistent compile cache (no new entries
+# written during its warmup).
+if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py; then
+  echo "check.sh: serving smoke OK (replica kill + hot-swap, 0 failed, cache-hit respawn)"
+else
+  echo "check.sh: serving SMOKE FAILED"
+  exit 1
+fi
+
 # ---- cluster observability smoke (ISSUE 7): a real 2-process heartbeat
 # run must merge rank 1's piggybacked snapshots on rank 0 — the script
 # asserts the cluster phase table renders with both rank columns and at

@@ -69,6 +69,12 @@ FAULT_POINTS: Dict[str, str] = {
         "micro-batcher stalls before an engine call; coords: batch "
         "(per-batcher flush index); params: delay_ms (default 50)"
     ),
+    "serve.replica_kill": (
+        "serving router SIGKILLs an engine-replica child; its "
+        "in-flight requests retry on a peer, the pool respawns it; "
+        "coords: tick (router health-loop tick), worker (replica "
+        "index)"
+    ),
     "snapshot.partial_write": (
         "solverstate write publishes a torn (truncated) file; coords: "
         "index (per-process save count), iter (parsed from the path); "

@@ -173,8 +173,13 @@ class ShmBatchCache:
         max_bytes: Optional[int] = None,
         registry_dir: Optional[str] = None,
         metrics: Optional[CacheMetrics] = None,
+        readonly: bool = False,
     ):
+        """``readonly``: attach-only mode (serving replicas) — ``get``
+        works, ``put`` is a counted no-op, so a consumer can never
+        publish into (or evict from) a namespace a training job owns."""
         self.namespace = namespace
+        self.readonly = bool(readonly)
         self._ns = hashlib.sha1(namespace.encode()).hexdigest()[:8]
         if max_bytes is None:
             max_bytes = int(
@@ -301,8 +306,12 @@ class ShmBatchCache:
     # ------------------------------------------------------------ writes
     def put(self, key: str, arrays: Dict[str, np.ndarray]) -> bool:
         """Publish a decoded batch.  False when it didn't (already
-        present, raced, or larger than the whole budget) — callers
-        never depend on a put landing."""
+        present, raced, larger than the whole budget, or the cache is
+        attached ``readonly``) — callers never depend on a put
+        landing."""
+        if self.readonly:
+            self.metrics.record("put_skipped")
+            return False
         metas: List[Tuple[str, str, tuple, int]] = []
         off = 0
         arrs = {k: np.ascontiguousarray(v) for k, v in arrays.items()}

@@ -18,17 +18,28 @@ engine behind a batched request queue:
   :class:`~sparknet_tpu.serve.server.Client` — stdlib HTTP front end
   (``/classify``, ``/healthz``, ``/metrics``) plus the in-process
   client tests and load generators drive.
-- :func:`~sparknet_tpu.serve.loadgen.run_loadgen` — offline
-  closed-loop load generator (``serve --bench``), the requests/s and
-  p99 record BENCH tracks alongside training img/s.
+- :func:`~sparknet_tpu.serve.loadgen.run_loadgen` /
+  :func:`~sparknet_tpu.serve.loadgen.run_http_loadgen` — offline and
+  over-the-wire closed-loop load generators (``serve --bench``), the
+  requests/s and p99 records BENCH tracks alongside training img/s.
+- :class:`~sparknet_tpu.serve.router.Router` — the production tier: a
+  stateless front load-balancing ``/classify`` over N replica
+  processes (spawned via ``supervise/pool.py``), peer-retrying a
+  killed replica's in-flight requests, and rolling weight hot-swaps
+  one replica at a time.
+- :mod:`~sparknet_tpu.serve.hotswap` — snapshot watch: newer
+  manifest-verified solverstates roll into serving automatically.
+- :mod:`~sparknet_tpu.serve.compile_cache` — per-net persistent XLA
+  compile cache; replica restarts skip AOT warmup.
 
 See docs/SERVING.md for the architecture and knob reference.
 """
 
 from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .engine import InferenceEngine
-from .loadgen import run_loadgen
+from .loadgen import run_http_loadgen, run_loadgen
 from .metrics import Counter, LatencyHistogram, ServeMetrics
+from .router import Router
 from .server import Client, InferenceServer
 
 __all__ = [
@@ -40,6 +51,8 @@ __all__ = [
     "InferenceServer",
     "LatencyHistogram",
     "MicroBatcher",
+    "Router",
     "ServeMetrics",
+    "run_http_loadgen",
     "run_loadgen",
 ]

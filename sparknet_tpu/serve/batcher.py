@@ -3,11 +3,32 @@
 Requests arrive one-at-a-time with a few rows each; the engine is
 fastest fed full buckets. The batcher sits between: a bounded
 thread-safe queue feeds a single worker thread that coalesces queued
-requests until either ``max_batch`` rows are gathered or the oldest
-request has waited ``max_latency_us`` — the classic throughput/latency
-dial. The bounded queue is the backpressure surface: when it is full,
-``submit`` fails fast with :class:`Backpressure` (the HTTP layer maps
-it to 503) instead of letting latency grow without bound.
+requests into engine batches. The bounded queue is the backpressure
+surface: when it is full, ``submit`` fails fast with
+:class:`Backpressure` (the HTTP layer maps it to 503) instead of
+letting latency grow without bound.
+
+Two admission policies (``mode=``):
+
+- ``"fill"`` (the PR 1 policy): gather until ``max_batch`` rows or the
+  oldest request has waited ``max_latency_us`` — fill-then-flush, the
+  classic fixed throughput/latency dial.  Its failure mode is mixed
+  load: a lone small request always waits out the whole window hoping
+  for co-riders that never come.
+- ``"continuous"``: a continuous admitter.  Late arrivals join the
+  assembling batch **up to the dispatch instant** (one final
+  non-blocking drain right before the engine call), and the wait
+  itself is decided per-tick by *deadline-aware bucket selection*:
+  keep waiting only while (a) the arrival-rate EWMA predicts enough
+  co-rider rows to reach a **bigger** bucket within the remaining
+  window — otherwise waiting buys padding, not throughput: dispatch
+  the small bucket now — and (b) the tightest request deadline can
+  still absorb the per-bucket service-time EWMA after the wait.  At
+  saturation (backlogged queue) the admitter drains straight to
+  ``max_batch`` and is batch-for-batch identical to fill-then-flush
+  (tests/test_serving_tier.py pins bit-equality); under mixed load it
+  dispatches early and p99 drops at the same offered rate
+  (``BENCH_MODEL=serving_tier`` measures it).
 
 A single worker thread is deliberate: the engine serializes on one
 device anyway, and one consumer keeps request ordering FIFO.
@@ -32,11 +53,17 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..telemetry import trace as _trace
+
+# EWMA smoothing for the continuous admitter's two estimators
+# (arrival rows/s, per-bucket service seconds): recent-biased enough to
+# track load shifts within tens of requests, smooth enough not to
+# whipsaw on one burst
+_EWMA_ALPHA = 0.3
 
 
 class Backpressure(RuntimeError):
@@ -71,6 +98,7 @@ class MicroBatcher:
         max_queue: int = 256,
         deadline_s: Optional[float] = None,
         metrics=None,
+        mode: str = "fill",
     ):
         """``engine``: anything with ``infer(rows) -> rows`` (the
         InferenceEngine; tests substitute stubs). ``max_batch``: row
@@ -79,10 +107,15 @@ class MicroBatcher:
         waits for co-riders before the batch is flushed anyway.
         ``max_queue``: bound on queued requests (backpressure).
         ``deadline_s``: default per-request deadline — a request still
-        queued past it is shed before compute (None disables)."""
+        queued past it is shed before compute (None disables).
+        ``mode``: ``"fill"`` or ``"continuous"`` (module docstring)."""
         from .. import chaos
 
+        if mode not in ("fill", "continuous"):
+            raise ValueError(f"MicroBatcher mode {mode!r}: want "
+                             f"fill|continuous")
         self.engine = engine
+        self.mode = mode
         self.max_batch = int(max_batch) or max(
             getattr(engine, "buckets", (32,))
         )
@@ -92,6 +125,12 @@ class MicroBatcher:
         # cached once: the disabled chaos path is one `is None` test
         self._chaos = chaos.get_plan()
         self._flushes = 0
+        # continuous-mode estimators (written by submit / _run, read by
+        # the worker's admission loop)
+        self._est_lock = threading.Lock()
+        self._arrival_rows_per_s = 0.0
+        self._last_arrival_t: Optional[float] = None
+        self._service_s: Dict[int, float] = {}
         self._q: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
         self._open = True
         self._worker = threading.Thread(
@@ -127,12 +166,61 @@ class MicroBatcher:
             raise Backpressure(
                 f"request queue full ({self._q.maxsize} pending)"
             ) from None
+        if self.mode == "continuous":
+            self._note_arrival(item)
         if self.metrics is not None:
             self.metrics.set_queue_depth(self._q.qsize())
         return item.future
 
+    # ----------------------------------------------------- estimators
+    def _note_arrival(self, item: _Pending) -> None:
+        """Arrival-rate EWMA (rows/s) over inter-arrival gaps — the
+        admitter's 'are co-riders coming?' signal."""
+        with self._est_lock:
+            last, self._last_arrival_t = self._last_arrival_t, item.t_enq
+            if last is None:
+                return  # first arrival: rate stays 0 -> dispatch eagerly
+            inst = item.n / max(item.t_enq - last, 1e-6)
+            self._arrival_rows_per_s = (
+                (1 - _EWMA_ALPHA) * self._arrival_rows_per_s
+                + _EWMA_ALPHA * inst
+            )
+
+    def _observe_service(self, bucket: int, seconds: float) -> None:
+        with self._est_lock:
+            prev = self._service_s.get(bucket)
+            self._service_s[bucket] = (
+                seconds if prev is None
+                else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * seconds
+            )
+
+    def _service_estimate(self, bucket: int) -> float:
+        """EWMA engine seconds for ``bucket``; falls back to the
+        nearest known bucket (0 when nothing observed yet)."""
+        with self._est_lock:
+            if not self._service_s:
+                return 0.0
+            got = self._service_s.get(bucket)
+            if got is not None:
+                return got
+            nearest = min(self._service_s, key=lambda b: abs(b - bucket))
+            return self._service_s[nearest]
+
+    def _arrival_rate(self) -> float:
+        with self._est_lock:
+            return self._arrival_rows_per_s
+
+    def _bucket_for(self, n: int) -> int:
+        fn = getattr(self.engine, "bucket_for", None)
+        n = min(int(n), self.max_batch)
+        return fn(n) if fn is not None else n
+
     # ------------------------------------------------------------------
     def _loop(self) -> None:
+        gather = (
+            self._gather_continuous if self.mode == "continuous"
+            else self._gather_fill
+        )
         while True:
             try:
                 first = self._q.get(timeout=0.05)
@@ -140,22 +228,78 @@ class MicroBatcher:
                 if not self._open:
                     return
                 continue
-            batch: List[_Pending] = [first]
-            total = first.n
-            deadline = time.perf_counter() + self.max_latency_s
-            while total < self.max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    item = self._q.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                batch.append(item)
-                total += item.n
+            batch, total = gather(first)
             if self.metrics is not None:
                 self.metrics.set_queue_depth(self._q.qsize())
             self._run(batch, total)
+
+    def _gather_fill(self, first: _Pending) -> Tuple[List[_Pending], int]:
+        """Fill-then-flush: wait out the window unless the batch fills
+        first (the PR 1 policy, kept as the A/B baseline)."""
+        batch: List[_Pending] = [first]
+        total = first.n
+        deadline = time.perf_counter() + self.max_latency_s
+        while total < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            batch.append(item)
+            total += item.n
+        return batch, total
+
+    def _gather_continuous(
+        self, first: _Pending
+    ) -> Tuple[List[_Pending], int]:
+        """Continuous admission + deadline-aware bucket selection (see
+        module docstring).  The final non-blocking drain means arrivals
+        join right up to the dispatch instant."""
+        batch: List[_Pending] = [first]
+        total = first.n
+        window_end = time.perf_counter() + self.max_latency_s
+        while total < self.max_batch:
+            # admit everything already queued — at saturation this runs
+            # straight to max_batch and matches fill-then-flush
+            # batch-for-batch
+            try:
+                while total < self.max_batch:
+                    item = self._q.get_nowait()
+                    batch.append(item)
+                    total += item.n
+                break
+            except queue.Empty:
+                pass
+            now = time.perf_counter()
+            wait = window_end - now
+            if wait <= 0:
+                break
+            cur_bucket = self._bucket_for(total)
+            # (b) the tightest deadline must absorb the wait AND the
+            # estimated service time for the bucket we'd dispatch
+            tight = min(
+                (it.deadline for it in batch if it.deadline is not None),
+                default=None,
+            )
+            if tight is not None:
+                slack = tight - now - self._service_estimate(cur_bucket)
+                wait = min(wait, slack)
+                if wait <= 0:
+                    break
+            # (a) small bucket now vs bigger bucket later: wait only if
+            # the predicted co-rider rows reach a bigger bucket
+            predicted = total + self._arrival_rate() * wait
+            if self._bucket_for(predicted) <= cur_bucket:
+                break
+            try:
+                item = self._q.get(timeout=wait)
+            except queue.Empty:
+                break
+            batch.append(item)
+            total += item.n
+        return batch, total
 
     def _run(self, batch: List[_Pending], total: int) -> None:
         if self._chaos is not None:
@@ -187,6 +331,7 @@ class MicroBatcher:
         if not live:
             return
         batch = live
+        t0 = time.perf_counter()
         try:
             with _trace.span("serve.flush", cat="serve",
                              requests=len(batch), rows=total):
@@ -203,6 +348,11 @@ class MicroBatcher:
                 if not it.future.cancelled():
                     it.future.set_exception(e)
             return
+        if self.mode == "continuous":
+            live_rows = sum(it.n for it in batch)
+            self._observe_service(
+                self._bucket_for(live_rows), time.perf_counter() - t0
+            )
         now = time.perf_counter()
         ofs = 0
         for it in batch:

@@ -1,0 +1,137 @@
+"""Persistent compile cache for serving — warm restarts skip AOT warmup.
+
+A serving replica's startup cost is dominated by XLA compilation: one
+AOT compile per (bucket, dtype) before the first request can be
+answered inside its latency budget.  Replica restarts (crash respawn,
+rolling hot-swap) and horizontal scale-out recompile the exact same
+programs from scratch — pure waste.  This module wires ``jax``'s
+persistent compilation cache to a **per-net directory** so a respawned
+replica deserializes yesterday's executables instead of recompiling:
+
+    root/<net-fingerprint>/   # jax cache entries for THIS net only
+
+The directory is keyed by :func:`net_fingerprint` — a content hash of
+the net's architecture (layer stack, blob shapes, param/state tree
+structure + shapes/dtypes) and the compute dtype.  jax's own entry key
+then covers the rest (bucket, backend, flags), so the effective key is
+(net fingerprint, bucket, dtype) — exactly the
+:class:`~sparknet_tpu.serve.engine.InferenceEngine` executable-cache
+key.  Weights are NOT part of the fingerprint: the engine passes
+params as executable *arguments*, so every weight hot-swap of the same
+arch reuses both the in-memory and the on-disk cache; a different arch
+gets a different directory and can never collide.
+
+Platform note (this jaxlib, 0.4.37): entries below the ambient
+``jax_persistent_cache_min_compile_time_secs`` floor are never
+persisted — the floor exists because serializing near-instant compiles
+segfaults this jaxlib (see tests/conftest.py) — so toy nets may not
+benefit; real nets (whole-second compiles) do, and the
+``BENCH_MODEL=serving_tier`` record measures the win.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+def net_fingerprint(net, params: Any, state: Any, compute_dtype=None) -> str:
+    """16-hex content hash of the net's *architecture* — stable across
+    processes and weight versions, different for any structural change.
+
+    Covers: layer (name, type, tops, bottoms), blob shapes, input
+    names, the param/state pytrees' paths + shapes + dtypes, and the
+    compute dtype.  Weight VALUES are deliberately excluded (see module
+    docstring)."""
+    import jax
+
+    def tree_sig(tree):
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return [
+            (jax.tree_util.keystr(path), str(leaf.dtype), list(leaf.shape))
+            for path, leaf in leaves
+        ]
+
+    doc = {
+        "layers": [
+            (l.name, l.type, list(l.top), list(l.bottom))
+            for l in net.layers
+        ],
+        "blobs": {
+            name: list(shape) for name, shape in net.blob_shapes.items()
+        },
+        "inputs": list(net.input_names),
+        "params": tree_sig(params),
+        "state": tree_sig(state),
+        "dtype": (
+            str(jax.numpy.dtype(compute_dtype))
+            if compute_dtype is not None else None
+        ),
+    }
+    raw = json.dumps(doc, sort_keys=True).encode()
+    return hashlib.sha256(raw).hexdigest()[:16]
+
+
+def cache_entries(path: str) -> int:
+    """How many cache entry files live under ``path`` (0 for a missing
+    dir).  jax names entries ``jit_*``/hash blobs one file each, so a
+    file count is an honest "did warmup hit or compile?" probe."""
+    try:
+        return sum(
+            1 for name in os.listdir(path)
+            if not name.startswith(".")
+            and os.path.isfile(os.path.join(path, name))
+        )
+    except OSError:
+        return 0
+
+
+def enable_persistent_cache(
+    root: str,
+    fingerprint: Optional[str] = None,
+    min_compile_time_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Point jax's persistent compilation cache at
+    ``root[/fingerprint]`` for THIS process.  Safe to call before or
+    after backend init: this jaxlib latches cache initialization once
+    (``_initialize_cache``; ``set_cache_dir`` alone does NOT unlatch),
+    so the latch is explicitly reset — the next compile re-initializes
+    against the new directory.  Returns ``{"dir", "entries"}`` —
+    ``entries`` is the pre-warmup count, so callers can diff it after
+    warmup to tell a cache-hit restart from a cold compile.
+
+    ``min_compile_time_s``: override the persistence floor (default:
+    ``SPARKNET_SERVE_CACHE_FLOOR_S``, 0.05).  Serving replicas *want*
+    sub-second inference compiles persisted — a replica restart's
+    warmup is the sum of them — and these single-device programs
+    round-trip the serializer safely (the known jaxlib crash is
+    specific to manual-collective executables, which ``jit_manual``
+    already keeps out of the cache; see tests/conftest.py and
+    parallel/comm.py)."""
+    import jax
+
+    path = os.path.join(root, fingerprint) if fingerprint else root
+    os.makedirs(path, exist_ok=True)
+    if min_compile_time_s is None:
+        min_compile_time_s = float(
+            os.environ.get("SPARKNET_SERVE_CACHE_FLOOR_S", "") or 0.05
+        )
+    # size floor off: serving executables are small
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(min_compile_time_s),
+    )
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc,
+        )
+
+        cc.reset_cache()  # drop the once-only init latch (see above)
+    except Exception:
+        # very old/new jax: the config route still applies at first use
+        pass
+    return {"dir": path, "entries": cache_entries(path)}

@@ -14,11 +14,20 @@ leak into the real rows, and the real rows' outputs are bit-identical
 to an unpadded run of the same executable bucket (tests/test_serve.py
 pins this).
 
-Compiled executables are cached per engine, keyed by (bucket, dtype);
-the net and weights are fixed per engine instance, so the key is
-effectively (net, bucket, dtype). Input buffers are donated to XLA on
-accelerators (they are request-scoped temporaries); donation is skipped
-on CPU where it only produces "donated buffer unused" noise.
+Weights are executable **arguments**, not baked-in constants: the
+compiled program depends only on the net's architecture, so a weight
+hot-swap (:meth:`InferenceEngine.swap`) is an atomic pointer exchange
+— zero recompiles, zero dropped requests — and a *different* arch can
+never hit a stale executable because the compile cache is keyed by
+``(net fingerprint, bucket, dtype)``
+(:func:`~sparknet_tpu.serve.compile_cache.net_fingerprint`).  Every
+swap bumps a monotone ``generation`` the HTTP layer tags responses
+with.  The same fingerprint keys the on-disk persistent compile cache
+(``serve/compile_cache.py``), so replica restarts skip AOT warmup.
+
+Input buffers are donated to XLA on accelerators (they are
+request-scoped temporaries); donation is skipped on CPU where it only
+produces "donated buffer unused" noise.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import trace as _trace
+from .compile_cache import net_fingerprint
 
 Rows = Union[np.ndarray, Dict[str, np.ndarray]]
 
@@ -42,7 +52,10 @@ def load_weights_any(net, params, state, weights: str):
     ``.caffemodel`` / ``.npz`` weight files (comma-separated lists
     overlay in order, later files winning — ``tools/_common`` rules) or
     a full ``.solverstate.npz``/``.orbax`` training snapshot, from
-    which params + net state (BN statistics) are extracted."""
+    which params + net state (BN statistics) are extracted.  Snapshot
+    loads run the PR 3 manifest verification — a torn file raises
+    :class:`~sparknet_tpu.solver.snapshot.SnapshotError` instead of
+    serving garbage weights (the hot-swap safety gate)."""
     from ..solver import snapshot as snap
 
     if weights.endswith((snap.NPZ_SUFFIX, snap.ORBAX_SUFFIX)):
@@ -80,8 +93,6 @@ class InferenceEngine:
         if not buckets:
             raise ValueError("InferenceEngine: need at least one bucket")
         self.net = net
-        self.params = params
-        self.state = state
         self.buckets: Tuple[int, ...] = tuple(sorted({int(b) for b in buckets}))
         if self.buckets[0] < 1:
             raise ValueError(f"buckets must be >= 1, got {self.buckets}")
@@ -102,8 +113,63 @@ class InferenceEngine:
         self._row_shapes = {
             name: tuple(net.blob_shapes[name][1:]) for name in self.input_names
         }
-        self._cache: Dict[Tuple[int, str], Any] = {}
+        self._cache: Dict[Tuple[str, int, str], Any] = {}
         self._compile_lock = threading.Lock()
+        # weights state: swapped atomically under _swap_lock; infer()
+        # snapshots (params, state, generation) once per call so a swap
+        # mid-stream never mixes generations within one batch
+        self._swap_lock = threading.Lock()
+        self.generation = 0
+        self.weights_source: Optional[str] = None
+        self.warmup_s: Optional[float] = None
+        self._install(params, state)
+
+    # ------------------------------------------------------------------
+    def _install(self, params, state) -> None:
+        """Normalize + publish a weight set (init and swap share this):
+        device arrays in, fingerprint recomputed — a structural change
+        (different arch) changes the executable-cache key, so stale
+        executables are unreachable by construction."""
+        to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        params, state = to_dev(params), to_dev(state)
+        self.fingerprint = net_fingerprint(
+            self.net, params, state, self.compute_dtype
+        )
+        self.params = params
+        self.state = state
+
+    def swap(
+        self, params, state, *, source: Optional[str] = None
+    ) -> int:
+        """Hot-swap the served weights; returns the new generation.
+        Atomic: in-flight ``infer`` calls finish on the snapshot they
+        took; the next call serves the new weights.  Same-arch swaps
+        reuse every compiled executable (weights are arguments); an
+        arch change re-keys the cache (and pays compiles — warm them
+        via :meth:`warmup` before routing traffic)."""
+        with self._swap_lock:
+            self._install(params, state)
+            self.generation += 1
+            self.weights_source = source
+            gen = self.generation
+        if self.metrics is not None:
+            self.metrics.record_hot_swap(gen)
+        return gen
+
+    def swap_from_file(self, weights: str) -> int:
+        """Load + verify + swap from any weights artifact.  Snapshot
+        files are manifest-verified by the loader (PR 3): a torn file
+        raises before the swap, so the old generation keeps serving."""
+        params, state = load_weights_any(
+            self.net, self.params, self.state, weights
+        )
+        return self.swap(params, state, source=weights)
+
+    def _weights_snapshot(self):
+        with self._swap_lock:
+            return (
+                self.params, self.state, self.generation, self.fingerprint
+            )
 
     # ------------------------------------------------------------------
     @classmethod
@@ -120,7 +186,10 @@ class InferenceEngine:
         params, state = net.init(jax.random.PRNGKey(0))
         if weights:
             params, state = load_weights_any(net, params, state, weights)
-        return cls(net, params, state, **kwargs)
+        eng = cls(net, params, state, **kwargs)
+        if weights:
+            eng.weights_source = weights
+        return eng
 
     # ------------------------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -134,14 +203,18 @@ class InferenceEngine:
     def _input_dtype(self, name: str):
         return jnp.int32 if name == "label" else self.compute_dtype
 
-    def _fwd(self, batch):
-        blobs, _ = self.net.apply(
-            self.params, self.state, batch, train=False, rng=None
-        )
+    def _fwd(self, params, state, batch):
+        blobs, _ = self.net.apply(params, state, batch, train=False, rng=None)
         return blobs[self.output]
 
-    def _executable(self, bucket: int):
-        key = (bucket, jnp.dtype(self.compute_dtype).name)
+    def _executable(self, bucket: int, weights=None):
+        """The compiled program for ``bucket``, against a consistent
+        (params, state, fingerprint) triple — the caller's snapshot, or
+        the engine's current weights."""
+        params, state, _, fingerprint = (
+            weights if weights is not None else self._weights_snapshot()
+        )
+        key = (fingerprint, bucket, jnp.dtype(self.compute_dtype).name)
         exe = self._cache.get(key)
         if exe is not None:
             return exe
@@ -155,10 +228,16 @@ class InferenceEngine:
                 )
                 for name in self.input_names
             }
-            donate = () if jax.default_backend() == "cpu" else (0,)
+            shape_of = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t
+            )
+            # donate the batch (arg 2) on accelerators: it is a
+            # request-scoped temporary; params/state (args 0/1) are the
+            # resident weights and must never be donated
+            donate = () if jax.default_backend() == "cpu" else (2,)
             exe = (
                 jax.jit(self._fwd, donate_argnums=donate)
-                .lower(structs)
+                .lower(shape_of(params), shape_of(state), structs)
                 .compile()
             )
             self._cache[key] = exe
@@ -166,9 +245,14 @@ class InferenceEngine:
 
     def warmup(self) -> "InferenceEngine":
         """Compile every bucket up front, so the first request of each
-        size never pays a compile inside its latency budget."""
+        size never pays a compile inside its latency budget.  Timed
+        into ``warmup_s`` — with the persistent compile cache enabled
+        (``serve/compile_cache.py``) a warm restart deserializes
+        instead of compiling, and this number is the proof."""
+        t0 = time.perf_counter()
         for b in self.buckets:
             self._executable(b)
+        self.warmup_s = round(time.perf_counter() - t0, 3)
         return self
 
     # ------------------------------------------------------------------
@@ -207,11 +291,19 @@ class InferenceEngine:
         return batch
 
     def infer(self, rows: Rows) -> np.ndarray:
+        """Run the net on ``rows``; see :meth:`infer_tagged`."""
+        return self.infer_tagged(rows)[0]
+
+    def infer_tagged(self, rows: Rows) -> Tuple[np.ndarray, int]:
         """Run the net on ``rows`` (an (N, ...) array for the first
         input, or a dict blob name -> (N, ...) array). Requests are
         padded up to the nearest bucket; N beyond the largest bucket is
-        chunked. Returns the output blob's first N rows as numpy."""
+        chunked. Returns ``(output rows, weights generation)`` — the
+        generation the WHOLE call was computed with (one snapshot per
+        call, so a concurrent swap never splits a request)."""
         batch = self._as_batch(rows)
+        weights = self._weights_snapshot()
+        params, state, gen, _ = weights
         n = len(next(iter(batch.values())))
         max_b = self.buckets[-1]
         outs = []
@@ -228,11 +320,12 @@ class InferenceEngine:
                     )
                     chunk = np.concatenate([chunk, pad])
                 dev[name] = jnp.asarray(chunk, self._input_dtype(name))
-            exe = self._executable(bucket)
+            exe = self._executable(bucket, weights)
             t0 = time.perf_counter()
             with _trace.span("serve.infer", cat="serve",
                              bucket=bucket, rows=take):
-                out = np.asarray(exe(dev))  # np.asarray is the device fence
+                # np.asarray is the device fence
+                out = np.asarray(exe(params, state, dev))
             if self.metrics is not None:
                 self.metrics.record_batch(
                     bucket,
@@ -242,7 +335,7 @@ class InferenceEngine:
                 )
             outs.append(out[:take])
             start += take
-        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+        return (outs[0] if len(outs) == 1 else np.concatenate(outs)), gen
 
     # ------------------------------------------------------------------
     def postprocess(self, out: np.ndarray, top_k: int = 5):

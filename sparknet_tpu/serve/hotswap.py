@@ -1,0 +1,139 @@
+"""Snapshot watch — training runs roll into serving with no downtime.
+
+The training side already writes atomic, manifest-verified snapshots
+(``solver/snapshot.py``, PR 3); the serving side can already swap
+weights between batches with zero dropped requests
+(``engine.swap_from_file``).  This module closes the loop: a
+:class:`SnapshotWatcher` polls a snapshot **prefix or run directory**
+for a newer solverstate, walks the manifest-verification chain
+(``newest_verified_solverstate`` — a torn newest file is skipped, not
+served), and hands the verified ``(iter, path)`` to a callback:
+
+- a standalone replica swaps itself (``serve --snapshot-watch``);
+- the router triggers a **rolling** reload — one replica at a time,
+  waiting for each to report the new generation healthy before moving
+  on (``serve/router.py``) — so a bad snapshot or slow swap can never
+  take the whole tier down at once.
+
+Polling (not inotify) is deliberate: snapshots land via rename on
+possibly-shared storage where watch APIs are unreliable, and the
+poll interval (seconds) is negligible against snapshot cadence
+(minutes).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..solver.snapshot import (
+    NPZ_SUFFIX,
+    ORBAX_SUFFIX,
+    SnapshotError,
+    load_state,
+    ordered_solverstates,
+)
+
+
+def snapshot_candidates(target: str) -> List[Tuple[int, str]]:
+    """Every solverstate under ``target`` as ``(iter, path)``, newest
+    first.  ``target`` may be a snapshot *prefix* (Caffe style, the
+    supervisor's shape) or a *directory* holding any number of
+    prefixes (the ``--snapshot-watch DIR`` shape)."""
+    if not os.path.isdir(target):
+        return ordered_solverstates(target)
+    out: List[Tuple[int, str]] = []
+    for suffix in (NPZ_SUFFIX, ORBAX_SUFFIX):
+        for path in glob.glob(os.path.join(target, f"*_iter_*{suffix}")):
+            m = re.search(
+                r"_iter_(\d+)\.solverstate\.(npz|orbax)$", path
+            )
+            if m:
+                out.append((int(m.group(1)), path))
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
+
+
+def newest_verified(
+    target: str, on_torn: Optional[Callable] = None
+) -> Optional[Tuple[int, str]]:
+    """Newest manifest-intact solverstate under ``target`` (prefix or
+    directory), or None.  The hot-swap safety gate: a torn or
+    wrong-era file is skipped (and reported via ``on_torn``), never
+    handed to a swap."""
+    for it, path in snapshot_candidates(target):
+        try:
+            load_state(path)
+        except (SnapshotError, ValueError) as e:
+            if on_torn is not None:
+                on_torn(path, e)
+            continue
+        return it, path
+    return None
+
+
+class SnapshotWatcher:
+    """Background poller: fires ``on_new(iter, path)`` whenever a
+    *newer* verified snapshot appears under ``target``.
+
+    ``on_new`` runs on the watcher thread; an exception from it leaves
+    the snapshot un-acted (retried next tick) — a transient swap
+    failure must not permanently skip a generation.  ``start_iter``
+    seeds "newer than" (e.g. the iter the replica booted with), so a
+    replica never re-swaps the weights it already serves."""
+
+    def __init__(
+        self,
+        target: str,
+        on_new: Callable[[int, str], None],
+        *,
+        interval_s: float = 2.0,
+        start_iter: Optional[int] = None,
+    ):
+        self.target = target
+        self.on_new = on_new
+        self.interval_s = float(interval_s)
+        self.last_iter = -1 if start_iter is None else int(start_iter)
+        self.torn_seen = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def poll_once(self) -> Optional[Tuple[int, str]]:
+        """One tick, callable without the thread (tests, manual roll):
+        acts + returns ``(iter, path)`` when a newer verified snapshot
+        was found, else None."""
+        def torn(path, e):
+            self.torn_seen += 1
+
+        got = newest_verified(self.target, on_torn=torn)
+        if got is None or got[0] <= self.last_iter:
+            return None
+        it, path = got
+        self.on_new(it, path)  # raises -> retried next tick
+        self.last_iter = it
+        return it, path
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # the callback failed (torn race, replica mid-restart):
+                # keep watching — the next tick retries
+                continue
+
+    def start(self) -> "SnapshotWatcher":
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-snapshot-watch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval_s + 5.0)

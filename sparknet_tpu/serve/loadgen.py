@@ -113,6 +113,98 @@ def run_loadgen(
     }
 
 
+def run_http_loadgen(
+    host: str,
+    port: int,
+    input_shape: Sequence[int],
+    *,
+    n_requests: int = 500,
+    sizes: Sequence[int] = (1, 2, 5, 8, 3),
+    concurrency: int = 4,
+    seed: int = 0,
+    timeout_s: float = 120.0,
+    retries: int = 4,
+) -> dict:
+    """The closed-loop generator over the WIRE — drives a router (or a
+    single replica) through :class:`~sparknet_tpu.serve.server.Client`,
+    so replica kills, hot-swaps and 503 backpressure are exercised
+    exactly as external traffic sees them.  Client-side retries
+    (connection drops, 503) are part of the contract: a request only
+    counts ``failed`` when its final answer is missing or non-200 —
+    the zero-failed-requests bar the chaos scenarios are held to.
+    Latency is measured per request *including* retries (a killed
+    replica costs latency, never answers) and the record carries every
+    distinct weights generation observed (``served_generations``)."""
+    from ..telemetry.registry import LatencyHistogram
+    from .server import Client
+
+    lat = LatencyHistogram()
+    counter = {"next": 0}
+    lock = threading.Lock()
+    errors = []
+    generations = set()
+
+    def worker(wid: int):
+        rng = np.random.default_rng(seed + wid)
+        client = Client(host, port, timeout=timeout_s, retries=retries)
+        while True:
+            with lock:
+                i = counter["next"]
+                if i >= n_requests:
+                    return
+                counter["next"] = i + 1
+            n = int(sizes[i % len(sizes)])
+            rows = rng.normal(size=(n,) + tuple(input_shape)).astype(
+                np.float32
+            )
+            t0 = time.perf_counter()
+            try:
+                status, resp = client.classify(rows)
+                if status != 200:
+                    raise RuntimeError(f"HTTP {status}: {resp.get('error')}")
+                if len(resp["indices"]) != n:
+                    raise RuntimeError(
+                        f"{len(resp['indices'])} rows back, sent {n}"
+                    )
+            except Exception as e:
+                with lock:
+                    errors.append(f"req {i}: {type(e).__name__}: {e}")
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.observe(dt)
+                if "gen" in resp:
+                    generations.add(int(resp["gen"]))
+
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(max(1, concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout_s * 2)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    snap = lat.snapshot()
+    total_rows = sum(int(sizes[i % len(sizes)]) for i in range(n_requests))
+    return {
+        "metric": "serve_http_requests_per_sec",
+        "value": round((n_requests - len(errors)) / dt, 2),
+        "unit": "requests/sec",
+        "rows_per_sec": round(total_rows / dt, 2),
+        "requests": n_requests,
+        "failed_requests": len(errors),
+        "error_samples": errors[:3],
+        "concurrency": max(1, concurrency),
+        "sizes": list(sizes),
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "p99_ms": snap["p99_ms"],
+        "served_generations": sorted(generations),
+    }
+
+
 def _platform() -> str:
     try:
         import jax

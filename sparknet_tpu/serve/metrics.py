@@ -53,6 +53,11 @@ class ServeMetrics:
         # /healthz surfaces it so a balancer can back off.
         self.shed = 0
         self.cancelled = 0
+        # weight hot-swaps (serve/engine.py swap()): count + the newest
+        # generation served, so /metrics and bench records carry the
+        # rolling-update story next to the latency story
+        self.hot_swaps = 0
+        self.generation = 0
         self._last_degraded_t: float = float("-inf")
         self._queue_depth = Gauge()
         self.request_latency = LatencyHistogram()
@@ -109,6 +114,12 @@ class ServeMetrics:
             self.cancelled += n
             self._last_degraded_t = time.perf_counter()
 
+    def record_hot_swap(self, generation: int) -> None:
+        """A weight hot-swap landed; ``generation`` is the new gen."""
+        with self._lock:
+            self.hot_swaps += 1
+            self.generation = max(self.generation, int(generation))
+
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(depth)
 
@@ -141,6 +152,8 @@ class ServeMetrics:
                 "errors": self.errors,
                 "shed": self.shed,
                 "cancelled": self.cancelled,
+                "hot_swaps": self.hot_swaps,
+                "generation": self.generation,
                 "health": (
                     "degraded"
                     if now - self._last_degraded_t < self.DEGRADED_WINDOW_S

@@ -1,0 +1,200 @@
+"""Engine-replica child process — one stateful backend of the tier.
+
+``python -m sparknet_tpu.serve.replica`` is what the router's
+:class:`~sparknet_tpu.supervise.pool.ChildPool` spawns N of: a full
+single-process serving stack (engine + batcher + HTTP server) that
+
+- binds an **ephemeral** port and publishes it through an atomically
+  written ``--portfile`` (JSON: host/port/pid/warmup_s/compile_cache)
+  — the router discovers respawned replicas by re-reading the file a
+  fresh spawn writes;
+- enables the **persistent compile cache** before warmup
+  (``--compile-cache ROOT`` -> ``ROOT/<net-fingerprint>/``), so a
+  respawn deserializes executables instead of recompiling — the
+  portfile carries entry counts before/after warmup, making a
+  cache-hit restart machine-checkable;
+- can watch a snapshot prefix/dir itself (``--snapshot-watch``) for
+  standalone use, though under a router the *router* drives the roll
+  and replicas only take explicit ``/reload``;
+- can attach **read-only** to a PR 8 decoded-batch cache namespace
+  (``--data-cache NS``): ``/classify`` accepts ``cache_key`` bodies
+  and the ``data_cache`` counters ride the replica's ``/metrics``.
+
+Kept deliberately free of router knowledge: a replica is just a
+server; the tier semantics (dispatch, retry, eject, roll) live in one
+place, ``serve/router.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def add_engine_args(ap: argparse.ArgumentParser) -> None:
+    """The engine/batcher flags shared verbatim by the replica entry
+    and ``tools/serve`` (single-process and router modes)."""
+
+    def int_list(text: str):
+        vals = [int(v) for v in text.split(",") if v.strip()]
+        if not vals:
+            raise argparse.ArgumentTypeError(f"empty int list: {text!r}")
+        return vals
+
+    ap.add_argument("--model", required=True, help="deploy .prototxt")
+    ap.add_argument(
+        "--weights", default=None,
+        help=".caffemodel | .npz | .solverstate.npz",
+    )
+    ap.add_argument(
+        "--buckets", type=int_list, default=[1, 8, 32],
+        help="batch-size buckets to pre-compile (requests pad up)",
+    )
+    ap.add_argument(
+        "--max-batch", type=int, default=0,
+        help="rows per engine call (default: largest bucket)",
+    )
+    ap.add_argument(
+        "--max-latency-us", type=int, default=2000,
+        help="longest a request waits for batch co-riders",
+    )
+    ap.add_argument(
+        "--max-queue", type=int, default=256,
+        help="queued-request bound (backpressure -> HTTP 503)",
+    )
+    ap.add_argument(
+        "--batch-mode", choices=("fill", "continuous"),
+        default="continuous",
+        help="admission policy: continuous (deadline-aware, the "
+             "default) or fill (fill-then-flush, the A/B baseline)",
+    )
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent compile cache root; executables land in "
+             "DIR/<net-fingerprint>/ and restarts skip AOT warmup",
+    )
+    ap.add_argument(
+        "--snapshot-watch", default=None, metavar="TARGET",
+        help="snapshot prefix or run dir: hot-swap to each newer "
+             "manifest-verified solverstate automatically",
+    )
+    ap.add_argument(
+        "--data-cache", default=None, metavar="NS",
+        help="attach read-only to a decoded-batch cache namespace "
+             "(PR 8); /classify then accepts cache_key bodies",
+    )
+
+
+def build_stack(args, *, watch_in_server: bool = True):
+    """args -> (engine, batcher, metrics, server) — the one place the
+    serving stack is assembled (replica, single-process CLI and tests
+    share it)."""
+    import jax.numpy as jnp
+
+    from .batcher import MicroBatcher
+    from .compile_cache import cache_entries, enable_persistent_cache
+    from .engine import InferenceEngine
+    from .metrics import ServeMetrics
+    from .server import InferenceServer
+
+    metrics = ServeMetrics(args.buckets)
+    engine = InferenceEngine.from_files(
+        args.model,
+        args.weights,
+        buckets=args.buckets,
+        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        metrics=metrics,
+    )
+    cache_info = None
+    if args.compile_cache:
+        # before warmup, after the net exists: the fingerprint names
+        # the per-net directory, warmup populates (or hits) it
+        cache_info = enable_persistent_cache(
+            args.compile_cache, engine.fingerprint
+        )
+    engine.warmup()
+    if cache_info is not None:
+        cache_info = dict(
+            cache_info,
+            entries_after=cache_entries(cache_info["dir"]),
+            warmup_s=engine.warmup_s,
+        )
+    batcher = MicroBatcher(
+        engine,
+        max_batch=args.max_batch,
+        max_latency_us=args.max_latency_us,
+        max_queue=args.max_queue,
+        metrics=metrics,
+        mode=args.batch_mode,
+    )
+    data_cache = None
+    if args.data_cache:
+        from ..data.cache import ShmBatchCache
+
+        data_cache = ShmBatchCache(namespace=args.data_cache, readonly=True)
+    server = InferenceServer(
+        engine,
+        batcher=batcher,
+        metrics=metrics,
+        host=args.host,
+        port=args.port,
+        model_name=os.path.basename(args.model),
+        default_top_k=args.top_k,
+        data_cache=data_cache,
+        watch=args.snapshot_watch if watch_in_server else None,
+        compile_cache_info=cache_info,
+    )
+    return engine, batcher, metrics, server
+
+
+def write_portfile(path: str, server, engine, cache_info) -> None:
+    """Atomic (tmp + rename): the router may read mid-write."""
+    doc = {
+        "host": server.host,
+        "port": server.port,
+        "pid": os.getpid(),
+        "warmup_s": getattr(engine, "warmup_s", None),
+        "generation": getattr(engine, "generation", 0),
+        "compile_cache": cache_info,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    from ..tools._common import honor_platform_env
+
+    honor_platform_env()
+    ap = argparse.ArgumentParser(
+        prog="sparknet-serve-replica",
+        description="one engine replica of the serving tier",
+    )
+    add_engine_args(ap)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 (default): ephemeral — see --portfile")
+    ap.add_argument("--portfile", default=None,
+                    help="where to publish the bound address (JSON)")
+    args = ap.parse_args(argv)
+
+    engine, batcher, metrics, server = build_stack(args)
+    if args.portfile:
+        write_portfile(args.portfile, server, engine,
+                       server.compile_cache_info)
+    print(
+        f"replica pid={os.getpid()} serving {args.model} on "
+        f"http://{server.host}:{server.port} "
+        f"(warmup {engine.warmup_s}s, mode={args.batch_mode})",
+        flush=True,
+    )
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

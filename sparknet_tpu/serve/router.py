@@ -1,0 +1,611 @@
+"""Serving router — a stateless front over N stateful engine replicas.
+
+The TensorFlow-paper shape (PAPERS.md, arXiv:1605.08695) applied to
+serving: all the state that is expensive to move (weights on device,
+compiled executables) lives in *replica* processes; everything the
+router holds (outstanding counts, health verdicts, the roll cursor) is
+reconstructible from one health sweep, so the router itself is cheap
+to restart and trivially correct to reason about.
+
+- **Dispatch** is least-outstanding-requests over healthy replicas
+  (ties round-robin): with one device per replica and micro-batching
+  underneath, queue depth IS the load signal — no weights, no EWMA.
+- **Failure = retry, never an error.**  ``/classify`` is idempotent
+  (pure function of rows + weights generation), so a dropped
+  connection or a 5xx from a dying replica re-dispatches the same body
+  to the next-best peer.  A killed replica costs the client latency,
+  never an answer; tests pin zero dropped/duplicated answers under
+  ``serve.replica_kill`` chaos.
+- **Health** is scrape-driven: a background loop polls each replica's
+  ``/healthz``, ejects after consecutive failures, rejoins on the
+  first success — and drives the
+  :class:`~sparknet_tpu.supervise.pool.ChildPool` tick that respawns
+  dead children under per-replica restart budgets (PR 4 policy
+  machinery, reused not reimplemented).
+- **Rolling hot-swap**: ``POST /reload`` (or the snapshot watcher
+  finding a newer manifest-verified solverstate) reloads replicas
+  **one at a time**, requiring each to answer healthy at the new
+  generation before the next starts — capacity dips by one replica,
+  never to zero, and a bad snapshot stops the roll at replica 0.
+
+The router speaks the same HTTP surface as a single replica
+(``/classify``, ``/healthz``, ``/metrics``, ``/metrics.json``,
+``/dash``, ``/reload``), so clients — including ``serve.Client`` and
+the load generator — cannot tell one process from a tier.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from ..telemetry.registry import REGISTRY, LatencyHistogram
+
+
+class Replica:
+    """One backend slot: address + live verdicts.  The process behind
+    it may change across respawns (the pool updates host/port)."""
+
+    def __init__(self, index: int, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.healthy = False
+        self.outstanding = 0
+        self.consecutive_fails = 0
+        self.generation: Optional[int] = None
+        self.warmup_s: Optional[float] = None
+        self.weights_source: Optional[str] = None
+        self.compile_cache: Optional[dict] = None
+        self.pid: Optional[int] = None
+        self.forwarded = 0
+        self.latency = LatencyHistogram()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "addr": (
+                f"{self.host}:{self.port}" if self.port is not None else None
+            ),
+            "healthy": self.healthy,
+            "outstanding": self.outstanding,
+            "generation": self.generation,
+            "warmup_s": self.warmup_s,
+            "weights_source": self.weights_source,
+            "compile_cache": self.compile_cache,
+            "pid": self.pid,
+            "forwarded": self.forwarded,
+            "latency": self.latency.snapshot(),
+        }
+
+
+class RouterMetrics:
+    """Router-level counters — registered as the telemetry registry's
+    ``"router"`` source, so ``/metrics`` (Prometheus), ``/metrics.json``
+    and bench records all see the tier without extra plumbing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.retries = 0
+        self.failed = 0          # requests that exhausted every peer
+        self.ejects = 0
+        self.rejoins = 0
+        self.replica_deaths = 0
+        self.respawns = 0
+        self.rolls = 0           # completed rolling hot-swaps
+        self.request_latency = LatencyHistogram()
+        REGISTRY.register_source("router", self)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "retries": self.retries,
+                "failed": self.failed,
+                "ejects": self.ejects,
+                "rejoins": self.rejoins,
+                "replica_deaths": self.replica_deaths,
+                "respawns": self.respawns,
+                "rolls": self.rolls,
+                "request_latency": self.request_latency.snapshot(),
+            }
+
+    def inc(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+        REGISTRY.counter("router_events", event=field).inc(n)
+
+
+class Router:
+    """Load-balancing front process over replica HTTP endpoints.
+
+    ``replicas``: a static address list ``[(host, port), ...]`` OR a
+    count when ``pool`` is given.  ``pool``: an optional
+    :class:`~sparknet_tpu.supervise.pool.ChildPool` whose children are
+    the replicas; the router's health loop drives its tick and
+    discovers (re)spawned replicas' ports via their portfiles
+    (``portfile_for(index, spawn)``).  ``watch``: snapshot prefix/dir
+    — a newer verified solverstate triggers a rolling reload."""
+
+    def __init__(
+        self,
+        replicas,
+        *,
+        pool=None,
+        portfile_for=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        model_name: str = "net",
+        health_interval_s: float = 0.5,
+        eject_after: int = 2,
+        forward_timeout_s: float = 60.0,
+        watch: Optional[str] = None,
+        watch_interval_s: float = 2.0,
+    ):
+        from .. import chaos
+
+        self.pool = pool
+        self.portfile_for = portfile_for
+        if pool is not None:
+            n = replicas if isinstance(replicas, int) else len(replicas)
+            self.replicas = [Replica(i) for i in range(n)]
+            if portfile_for is None:
+                raise ValueError("Router: a pool needs portfile_for")
+        else:
+            self.replicas = [
+                Replica(i, h, p)
+                for i, (h, p) in enumerate(list(replicas))
+            ]
+        if not self.replicas:
+            raise ValueError("Router: need at least one replica")
+        self.model_name = model_name
+        self.health_interval_s = float(health_interval_s)
+        self.eject_after = int(eject_after)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.metrics = RouterMetrics()
+        self._chaos = chaos.get_plan()
+        self._lock = threading.Lock()       # replica verdicts + counts
+        self._rr = itertools.count()
+        self._roll_lock = threading.Lock()  # one roll at a time
+        self._tick = 0
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._watch_target = watch
+        self._watcher = None
+        self._watch_interval_s = watch_interval_s
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, payload: dict, headers=()):
+                body = json.dumps(payload).encode()
+                self._send(code, body, "application/json", headers)
+
+            def _send(self, code, body, ctype, headers=()):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, outer.healthz())
+                elif self.path == "/metrics":
+                    from ..telemetry.exporter import render_prometheus
+
+                    self._send(
+                        200, render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/metrics.json":
+                    self._reply(200, outer.snapshot())
+                elif self.path == "/dash":
+                    from ..telemetry import REGISTRY as _REG
+                    from ..telemetry import anomaly as _anomaly
+                    from ..telemetry import dash as _dash
+
+                    page = _dash.render_html(
+                        _REG.snapshot(),
+                        anomalies=_anomaly.active(),
+                        model_name=outer.model_name,
+                        router=outer.snapshot(),
+                    )
+                    self._send(
+                        200, page.encode(), "text/html; charset=utf-8"
+                    )
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if self.path == "/classify":
+                    code, payload, headers = outer.dispatch(body)
+                    self._send(
+                        code, payload, "application/json", headers
+                    )
+                elif self.path == "/reload":
+                    try:
+                        req = json.loads(body or b"{}")
+                    except ValueError as e:
+                        self._reply(400, {"error": f"bad request: {e}"})
+                        return
+                    code, payload = outer.roll(req.get("weights"))
+                    self._reply(code, payload)
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._http_thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- replica IO
+    def _replica_request(
+        self, rep: Replica, method: str, path: str,
+        body: Optional[bytes] = None, timeout: Optional[float] = None,
+    ) -> Tuple[int, bytes]:
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port,
+            timeout=timeout if timeout is not None else self.forward_timeout_s,
+        )
+        try:
+            headers = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    # -------------------------------------------------------------- routing
+    def _pick(self, exclude: set) -> Optional[Replica]:
+        """Least-outstanding healthy replica not yet tried; ties break
+        round-robin so equal-load replicas share work."""
+        with self._lock:
+            ready = [
+                r for r in self.replicas
+                if r.healthy and r.port is not None
+                and r.index not in exclude
+            ]
+            if not ready:
+                return None
+            low = min(r.outstanding for r in ready)
+            tied = [r for r in ready if r.outstanding == low]
+            rep = tied[next(self._rr) % len(tied)]
+            rep.outstanding += 1
+            REGISTRY.gauge(
+                "router_outstanding", replica=rep.index
+            ).set(rep.outstanding)
+            return rep
+
+    def _done(self, rep: Replica, latency_s: Optional[float] = None) -> None:
+        with self._lock:
+            rep.outstanding -= 1
+            rep.forwarded += 1
+            if latency_s is not None:
+                rep.latency.observe(latency_s)
+            REGISTRY.gauge(
+                "router_outstanding", replica=rep.index
+            ).set(rep.outstanding)
+
+    def _note_fail(self, rep: Replica) -> None:
+        """A forward failed mid-request: treat it like a failed health
+        probe so the very next pick skips the replica instead of
+        waiting for the sweep to notice."""
+        with self._lock:
+            rep.consecutive_fails += 1
+            if rep.healthy and rep.consecutive_fails >= self.eject_after:
+                rep.healthy = False
+                self.metrics.inc("ejects")
+
+    def dispatch(self, body: bytes) -> Tuple[int, bytes, list]:
+        """Forward one /classify body; retries on peers until a replica
+        answers (anything but a connection failure / 5xx counts as an
+        answer — 400s are the client's problem, not the tier's)."""
+        self.metrics.inc("requests")
+        t0 = time.perf_counter()
+        tried: set = set()
+        last_err: Optional[str] = None
+        # one full pass over the tier, plus one grace re-pass after a
+        # short wait — a respawning replica (or a rolling swap) is a
+        # latency blip, not an outage
+        for attempt in range(2 * len(self.replicas) + 1):
+            rep = self._pick(tried)
+            if rep is None:
+                if attempt and tried:
+                    # every healthy peer tried and failed this pass:
+                    # clear the exclusion set, give the tier one beat
+                    # to eject/respawn, then re-pick
+                    tried = set()
+                    time.sleep(self.health_interval_s)
+                    continue
+                break
+            try:
+                status, payload = self._replica_request(
+                    rep, "POST", "/classify", body
+                )
+            except (OSError, http.client.HTTPException) as e:
+                self._done(rep)
+                self._note_fail(rep)
+                tried.add(rep.index)
+                last_err = f"replica {rep.index}: {type(e).__name__}: {e}"
+                self.metrics.inc("retries")
+                continue
+            if status >= 500 or status == 503:
+                # dying or overloaded replica: the request is
+                # idempotent — retry it on a peer
+                self._done(rep)
+                tried.add(rep.index)
+                last_err = f"replica {rep.index}: HTTP {status}"
+                self.metrics.inc("retries")
+                continue
+            self._done(rep, time.perf_counter() - t0)
+            self.metrics.request_latency.observe(time.perf_counter() - t0)
+            return status, payload, [("X-Sparknet-Replica", str(rep.index))]
+        self.metrics.inc("failed")
+        err = json.dumps({
+            "error": "no replica available"
+            + (f" (last: {last_err})" if last_err else "")
+        }).encode()
+        return 503, err, [("Retry-After", "1")]
+
+    # --------------------------------------------------------------- health
+    def _probe(self, rep: Replica) -> None:
+        if rep.port is None:
+            return
+        try:
+            status, payload = self._replica_request(
+                rep, "GET", "/healthz", timeout=2.0
+            )
+            doc = json.loads(payload or b"{}")
+        except (OSError, http.client.HTTPException, ValueError):
+            status, doc = 0, {}
+        with self._lock:
+            if status == 200:
+                rep.consecutive_fails = 0
+                if not rep.healthy:
+                    rep.healthy = True
+                    self.metrics.inc("rejoins")
+                rep.generation = doc.get("generation")
+                rep.warmup_s = doc.get("warmup_s")
+                rep.weights_source = doc.get("weights_source")
+                rep.compile_cache = doc.get("compile_cache")
+                rep.pid = doc.get("pid")
+            else:
+                rep.consecutive_fails += 1
+                if (
+                    rep.healthy
+                    and rep.consecutive_fails >= self.eject_after
+                ):
+                    rep.healthy = False
+                    self.metrics.inc("ejects")
+
+    def _refresh_ports(self) -> None:
+        """Pool mode: learn (re)spawned replicas' ephemeral ports from
+        their portfiles (a respawn writes a fresh file)."""
+        if self.pool is None:
+            return
+        for child, rep in zip(self.pool.children, self.replicas):
+            if child.spawn_count == 0:
+                continue
+            path = self.portfile_for(child.index, child.spawn_count - 1)
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            with self._lock:
+                if rep.port != doc.get("port"):
+                    rep.host = doc.get("host", "127.0.0.1")
+                    rep.port = doc.get("port")
+                    rep.consecutive_fails = 0
+
+    def health_tick(self) -> None:
+        """One sweep: pool tick (respawns), chaos, port discovery,
+        probes.  Public so tests can drive it without the thread."""
+        self._tick += 1
+        if self.pool is not None:
+            if self._chaos is not None:
+                for rep in self.replicas:
+                    rule = self._chaos.match(
+                        "serve.replica_kill",
+                        tick=self._tick, worker=rep.index,
+                    )
+                    if rule is not None and self.pool.kill(rep.index):
+                        with self._lock:
+                            rep.healthy = False
+                        self.metrics.inc("replica_deaths")
+            for ev in self.pool.tick():
+                if ev["event"] == "exit":
+                    self.metrics.inc("replica_deaths")
+                    with self._lock:
+                        self.replicas[ev["child"]].healthy = False
+                elif ev["event"] == "spawn" and ev["spawn"] > 1:
+                    self.metrics.inc("respawns")
+                    from .. import chaos
+
+                    chaos.record_recovery("serve.replica_respawn")
+            self._refresh_ports()
+        for rep in self.replicas:
+            self._probe(rep)
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self.health_tick()
+            except Exception:
+                continue  # a probe crash must not kill the tier
+
+    # ------------------------------------------------------------- hot swap
+    def roll(self, weights: Optional[str] = None) -> Tuple[int, dict]:
+        """Rolling reload: one replica at a time, each must answer the
+        new generation healthy before the next starts.  Serialized —
+        two concurrent rolls would take two replicas out at once."""
+        with self._roll_lock:
+            if weights is None and self._watch_target is not None:
+                from . import hotswap
+
+                got = hotswap.newest_verified(self._watch_target)
+                if got is None:
+                    return 409, {
+                        "error": "no intact solverstate under "
+                                 f"{self._watch_target!r}"
+                    }
+                weights = got[1]
+            if not weights:
+                return 400, {"error": "no weights given and no "
+                                      "snapshot watch configured"}
+            rolled, errors = [], []
+            for rep in list(self.replicas):
+                with self._lock:
+                    ok = rep.healthy and rep.port is not None
+                if not ok:
+                    continue
+                try:
+                    status, payload = self._replica_request(
+                        rep, "POST", "/reload",
+                        json.dumps({"weights": weights}).encode(),
+                    )
+                    doc = json.loads(payload or b"{}")
+                except (OSError, http.client.HTTPException, ValueError) as e:
+                    errors.append(
+                        f"replica {rep.index}: {type(e).__name__}: {e}"
+                    )
+                    break
+                if status != 200:
+                    # a bad snapshot fails on the FIRST replica and the
+                    # roll stops — the rest of the tier never sees it
+                    errors.append(
+                        f"replica {rep.index}: HTTP {status}: "
+                        f"{doc.get('error')}"
+                    )
+                    break
+                self._probe(rep)  # pick up the new generation verdict
+                rolled.append(
+                    {"replica": rep.index,
+                     "generation": doc.get("generation")}
+                )
+            if rolled and not errors:
+                self.metrics.inc("rolls")
+            code = 200 if rolled and not errors else 502
+            return code, {
+                "rolled": rolled,
+                "errors": errors,
+                "source": weights,
+            }
+
+    def _on_new_snapshot(self, it: int, path: str) -> None:
+        code, payload = self.roll(path)
+        if code != 200:
+            raise RuntimeError(f"rolling reload failed: {payload}")
+
+    # ------------------------------------------------------------ lifecycle
+    def wait_healthy(
+        self, n: Optional[int] = None, timeout_s: float = 120.0
+    ) -> bool:
+        """Block until ``n`` replicas (default: all) answer healthy —
+        the CLI's serve-traffic gate and the tests' barrier."""
+        want = len(self.replicas) if n is None else int(n)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            # only tick ourselves when no health thread is running —
+            # two concurrent tickers would race the pool's event list
+            if self._health_thread is None or not (
+                self._health_thread.is_alive()
+            ):
+                self.health_tick()
+            with self._lock:
+                if sum(r.healthy for r in self.replicas) >= want:
+                    return True
+            time.sleep(min(0.2, self.health_interval_s))
+        return False
+
+    def healthz(self) -> Dict[str, Any]:
+        with self._lock:
+            reps = [r.snapshot() for r in self.replicas]
+        healthy = sum(1 for r in reps if r["healthy"])
+        gens = {r["generation"] for r in reps if r["healthy"]}
+        return {
+            "status": (
+                "ok" if healthy == len(reps)
+                else "degraded" if healthy else "down"
+            ),
+            "role": "router",
+            "model": self.model_name,
+            "replicas_healthy": healthy,
+            "replicas_total": len(reps),
+            "generations": sorted(g for g in gens if g is not None),
+            "replicas": reps,
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = self.healthz()
+        out["router"] = self.metrics.snapshot()
+        if self.pool is not None:
+            out["pool"] = self.pool.snapshot()
+        return out
+
+    def start(self) -> "Router":
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="router-health", daemon=True
+        )
+        self._health_thread.start()
+        if self._watch_target is not None:
+            from . import hotswap
+
+            self._watcher = hotswap.SnapshotWatcher(
+                self._watch_target,
+                self._on_new_snapshot,
+                interval_s=self._watch_interval_s,
+            ).start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="router-http", daemon=True,
+        )
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.stop()
+            self._watcher = None
+        if self._health_thread is not None:
+            self._health_thread.join(self.health_interval_s + 5.0)
+        if self._http_thread is not None:
+            # shutdown() blocks on serve_forever's exit handshake — only
+            # valid when the HTTP thread actually ran
+            self._httpd.shutdown()
+            self._http_thread.join(10)
+        self._httpd.server_close()
+        if self.pool is not None:
+            self.pool.stop()
+
+    def serve_forever(self) -> None:
+        """Foreground mode for the CLI."""
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def client(self, timeout: float = 60.0):
+        from .server import Client
+
+        return Client(self.host, self.port, timeout=timeout)

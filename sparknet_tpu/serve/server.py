@@ -4,8 +4,20 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
 
 - ``POST /classify``  body ``{"rows": [[...]...], "top_k": 5}`` —
   rows are per-sample input arrays (net input shape, e.g. H×W×C
-  nested lists). Response ``{"indices": [[...]], "probs": [[...]]}``.
-  Shape errors -> 400; queue backpressure -> 503 with Retry-After.
+  nested lists). Response ``{"indices": [[...]], "probs": [[...]],
+  "gen": N}`` — ``gen`` is the weights generation that served the
+  request (hot-swap observability). Shape errors -> 400; queue
+  backpressure -> 503 with Retry-After.  With a decoded-batch cache
+  attached (``data_cache=``, PR 8's cross-job shm cache), the body
+  may carry ``{"cache_key": "..."}`` instead of rows: the replica
+  reads the already-decoded batch out of shared memory — co-located
+  training jobs and serving replicas share one decode — and a cache
+  miss is a 404, never a recompute.
+- ``POST /reload``  body ``{"weights": path}`` (or ``{}`` with a
+  snapshot watch configured: the newest **manifest-verified**
+  solverstate under the watch target).  Swaps weights between batches
+  with zero dropped requests; a torn snapshot -> 409 and the old
+  generation keeps serving.  Response ``{"generation", "source"}``.
 - ``GET /healthz`` — liveness + model identity + bucket config; the
   ``status`` field degrades to ``"degraded"`` while requests are being
   shed/cancelled (deadline pressure) or while a ``queue_stall`` /
@@ -37,7 +49,9 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import random
+import re
 import socket
 import threading
 import time
@@ -63,12 +77,28 @@ class InferenceServer:
         model_name: str = "net",
         default_top_k: int = 5,
         request_timeout_s: float = 60.0,
+        data_cache=None,
+        watch: Optional[str] = None,
+        watch_interval_s: float = 2.0,
+        compile_cache_info: Optional[dict] = None,
     ):
         """``port=0`` binds an ephemeral port (tests); the bound port is
-        ``self.port`` either way."""
+        ``self.port`` either way.  ``data_cache``: an attached (read-
+        only) ``ShmBatchCache`` serving ``cache_key`` requests.
+        ``watch``: snapshot prefix/dir — a newer manifest-verified
+        solverstate under it is hot-swapped automatically.
+        ``compile_cache_info``: the ``enable_persistent_cache`` record,
+        surfaced in ``/healthz`` so a respawn's warm/cold warmup is
+        observable."""
         from .. import chaos
 
         self.engine = engine
+        self.data_cache = data_cache
+        self.compile_cache_info = compile_cache_info
+        self._watch_target = watch
+        self._watch_interval_s = watch_interval_s
+        self._watcher = None
+        self._reload_lock = threading.Lock()
         self.metrics = (
             metrics
             if metrics is not None
@@ -123,20 +153,37 @@ class InferenceServer:
                         # does (and clears when the advisory expires,
                         # the PR-3 degraded-window semantics)
                         status = "degraded"
-                    self._reply(
-                        200,
-                        {
-                            "status": status,
-                            "model": outer.model_name,
-                            "buckets": list(
-                                getattr(outer.engine, "buckets", ())
-                            ),
-                            "output": getattr(outer.engine, "output", None),
-                            "shed": outer.metrics.shed,
-                            "cancelled": outer.metrics.cancelled,
-                            "anomalies": active,
-                        },
-                    )
+                    payload = {
+                        "status": status,
+                        "model": outer.model_name,
+                        "buckets": list(
+                            getattr(outer.engine, "buckets", ())
+                        ),
+                        "output": getattr(outer.engine, "output", None),
+                        "shed": outer.metrics.shed,
+                        "cancelled": outer.metrics.cancelled,
+                        "anomalies": active,
+                        # the hot-swap / warm-restart story: which
+                        # weights generation this replica serves, where
+                        # it came from, and what warmup cost at boot
+                        "generation": getattr(
+                            outer.engine, "generation", 0
+                        ),
+                        "weights_source": getattr(
+                            outer.engine, "weights_source", None
+                        ),
+                        "warmup_s": getattr(
+                            outer.engine, "warmup_s", None
+                        ),
+                        "pid": os.getpid(),
+                    }
+                    if outer.compile_cache_info is not None:
+                        payload["compile_cache"] = outer.compile_cache_info
+                    if outer.data_cache is not None:
+                        payload["data_cache"] = (
+                            outer.data_cache.metrics.snapshot()
+                        )
+                    self._reply(200, payload)
                 elif self.path == "/dash":
                     # the zero-dependency live dashboard
                     # (telemetry/dash.py, docs/OBSERVABILITY.md)
@@ -175,6 +222,16 @@ class InferenceServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/reload":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        req = json.loads(self.rfile.read(length) or b"{}")
+                    except ValueError as e:
+                        self._reply(400, {"error": f"bad request: {e}"})
+                        return
+                    code, payload = outer.reload(req.get("weights"))
+                    self._reply(code, payload)
+                    return
                 if self.path != "/classify":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
@@ -193,8 +250,46 @@ class InferenceServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
-                    rows = np.asarray(req["rows"], np.float32)
                     top_k = int(req.get("top_k", outer.default_top_k))
+                    if "rows" in req:
+                        rows = np.asarray(req["rows"], np.float32)
+                    elif "cache_key" in req:
+                        # decoded-batch cache path: the rows already
+                        # live in shared memory (PR 8) — pull them out
+                        # instead of shipping megabytes over HTTP
+                        if outer.data_cache is None:
+                            self._reply(
+                                400,
+                                {"error": "no data cache attached "
+                                          "(serve --data-cache NS)"},
+                            )
+                            return
+                        cached = outer.data_cache.get(
+                            str(req["cache_key"])
+                        )
+                        if cached is None:
+                            self._reply(
+                                404,
+                                {"error": "cache miss: "
+                                          f"{req['cache_key']!r}"},
+                            )
+                            return
+                        # cached batches are blob dicts; the batcher
+                        # coalesces row arrays — pull the net's first
+                        # input blob out
+                        name = getattr(
+                            outer.engine, "input_names", ["data"]
+                        )[0]
+                        rows = cached.get(name)
+                        if rows is None:
+                            self._reply(
+                                404,
+                                {"error": f"cached batch lacks input "
+                                          f"blob {name!r}"},
+                            )
+                            return
+                    else:
+                        raise KeyError("rows")
                 except (KeyError, ValueError, TypeError) as e:
                     outer.metrics.record_error()
                     self._reply(400, {"error": f"bad request: {e}"})
@@ -241,7 +336,14 @@ class InferenceServer:
                 idx, probs = outer.engine.postprocess(out, top_k)
                 self._reply(
                     200,
-                    {"indices": idx.tolist(), "probs": probs.tolist()},
+                    {
+                        "indices": idx.tolist(),
+                        "probs": probs.tolist(),
+                        # generation tag: monotone across hot-swaps
+                        # (tests pin monotonicity), so clients and the
+                        # router can see a rolling update propagate
+                        "gen": getattr(outer.engine, "generation", 0),
+                    },
                 )
 
         self.default_top_k = default_top_k
@@ -252,7 +354,72 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
+    def reload(self, weights: Optional[str] = None):
+        """Hot-swap the engine's weights; returns ``(http_code,
+        payload)`` (the ``/reload`` route's contract, also callable
+        in-process).  No explicit path + a snapshot watch configured
+        picks the newest manifest-verified solverstate under the watch
+        target.  Serialized under a lock: concurrent reloads would
+        interleave generations."""
+        from ..solver.snapshot import SnapshotError
+        from . import hotswap
+
+        with self._reload_lock:
+            path = weights
+            if not path:
+                if not self._watch_target:
+                    return 400, {
+                        "error": "no weights given and no snapshot "
+                                 "watch configured"
+                    }
+                got = hotswap.newest_verified(self._watch_target)
+                if got is None:
+                    return 409, {
+                        "error": "no intact solverstate under "
+                                 f"{self._watch_target!r}"
+                    }
+                path = got[1]
+            try:
+                gen = self.engine.swap_from_file(path)
+            except SnapshotError as e:
+                # the PR 3 verification gate: torn file -> the old
+                # generation keeps serving, the caller hears why
+                return 409, {"error": f"snapshot torn: {e}"}
+            except (FileNotFoundError, ValueError) as e:
+                return 400, {"error": f"{type(e).__name__}: {e}"}
+            except Exception as e:
+                return 500, {"error": f"{type(e).__name__}: {e}"}
+            return 200, {"generation": gen, "source": path}
+
+    def _on_new_snapshot(self, it: int, path: str) -> None:
+        code, payload = self.reload(path)
+        if code != 200:
+            # raising leaves the watcher's high-water mark unmoved, so
+            # the next tick retries instead of skipping the generation
+            raise RuntimeError(f"auto-reload failed: {payload}")
+
+    def _start_watcher(self) -> None:
+        if self._watch_target is None or self._watcher is not None:
+            return
+        from . import hotswap
+
+        # seed "newer than" with the iter the engine booted from, so a
+        # fresh replica doesn't immediately re-swap its own weights
+        start_iter = None
+        src = getattr(self.engine, "weights_source", None) or ""
+        m = re.search(r"_iter_(\d+)\.solverstate\.(npz|orbax)$", src)
+        if m:
+            start_iter = int(m.group(1))
+        self._watcher = hotswap.SnapshotWatcher(
+            self._watch_target,
+            self._on_new_snapshot,
+            interval_s=self._watch_interval_s,
+            start_iter=start_iter,
+        ).start()
+
+    # ------------------------------------------------------------------
     def start(self) -> "InferenceServer":
+        self._start_watcher()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="serve-http",
@@ -263,6 +430,9 @@ class InferenceServer:
 
     def stop(self) -> None:
         """Stop accepting, drain the batcher, close the socket."""
+        if self._watcher is not None:
+            self._watcher.stop()
+            self._watcher = None
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(10)
@@ -271,11 +441,15 @@ class InferenceServer:
 
     def serve_forever(self) -> None:
         """Foreground mode for the CLI: blocks until interrupted."""
+        self._start_watcher()
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
+            if self._watcher is not None:
+                self._watcher.stop()
+                self._watcher = None
             self.batcher.drain()
             self._httpd.server_close()
 
@@ -401,3 +575,16 @@ class Client:
         return self._request(
             "POST", "/classify", {"rows": rows.tolist(), "top_k": top_k}
         )
+
+    def classify_cached(self, cache_key: str, top_k: int = 5):
+        """Classify a batch already sitting in the shared decoded-batch
+        cache (PR 8) — the rows never cross the wire."""
+        return self._request(
+            "POST", "/classify", {"cache_key": cache_key, "top_k": top_k}
+        )
+
+    def reload(self, weights: Optional[str] = None):
+        """Trigger a weight hot-swap (None: the server's snapshot
+        watch picks the newest verified solverstate)."""
+        payload = {} if weights is None else {"weights": weights}
+        return self._request("POST", "/reload", payload)

@@ -260,6 +260,35 @@ def ordered_solverstates(prefix: str) -> List[Tuple[int, str]]:
     return out
 
 
+def newest_verified_solverstate(
+    prefix: str, on_torn=None, on_unrestorable=None
+) -> Optional[Tuple[int, str]]:
+    """The newest *intact* solverstate under ``prefix`` — the manifest
+    walk :func:`restore_with_fallback` performs, done up front so the
+    caller knows the resume/serve point before paying for a load.
+
+    Shared by the supervisor's pre-relaunch verification and the
+    serving tier's snapshot watcher (``serve/hotswap.py``): both must
+    never act on a torn newest file.  ``on_torn(path, err)`` /
+    ``on_unrestorable(path, err)`` observe skipped candidates (torn =
+    corruption, unrestorable = valid file from another format era).
+    Returns ``(iter, path)`` or None when nothing under the prefix is
+    intact."""
+    for it, path in ordered_solverstates(prefix):
+        try:
+            load_state(path)
+        except SnapshotError as e:
+            if on_torn is not None:
+                on_torn(path, e)
+            continue
+        except ValueError as e:
+            if on_unrestorable is not None:
+                on_unrestorable(path, e)
+            continue
+        return it, path
+    return None
+
+
 def latest_solverstate(prefix: str) -> Optional[str]:
     """Highest-iteration ``{prefix}_iter_N.solverstate.npz`` on disk, or
     None.  The auto-resume substrate: after a preemption, relaunching

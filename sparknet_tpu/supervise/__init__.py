@@ -15,6 +15,10 @@ The recovery loop above the heartbeat fabric and the snapshot layer
   last completed iteration); the supervisor's attribution evidence.
 - :mod:`~sparknet_tpu.supervise.policy` — restart budget, capped
   exponential backoff, flap detection, elastic width bookkeeping.
+- :class:`~sparknet_tpu.supervise.pool.ChildPool` — the keep-N-alive
+  loop as a reusable API: N *independent* children, per-child policy,
+  non-blocking tick-driven respawns.  The serving router's replica
+  pool (``serve/router.py``) is built on it.
 - :mod:`~sparknet_tpu.supervise.metrics` — the ``supervisor:`` JSON
   line (built on the serve/chaos ``Counter`` registry).
 
@@ -27,8 +31,10 @@ from __future__ import annotations
 
 from . import records
 from .policy import Config, ElasticState, RestartPolicy, classify_exit
+from .pool import ChildPool
 
 __all__ = [
+    "ChildPool",
     "Config",
     "ElasticState",
     "METRICS",

@@ -1,0 +1,239 @@
+"""ChildPool — the supervisor's keep-N-children-alive loop as a
+reusable API.
+
+PR 4's :class:`~sparknet_tpu.supervise.supervisor.Supervisor` owns one
+*gang*: all children form a single job, one failure fails the
+generation, restarts relaunch the whole width.  A serving tier needs
+the opposite shape — N **independent** children (engine replicas),
+each with its own restart budget, backoff ladder and flap detector,
+where one child dying is routine and must never touch its peers.  Both
+shapes share the same policy primitives
+(:class:`~sparknet_tpu.supervise.policy.RestartPolicy`,
+:class:`~sparknet_tpu.supervise.policy.Config`,
+:func:`~sparknet_tpu.supervise.policy.classify_exit`); this module
+packages the per-child loop:
+
+- ``start()`` spawns every child; ``tick()`` (called from the owner's
+  periodic loop — the serving router's health loop) polls them,
+  classifies exits, consults the child's policy, and respawns after
+  the backoff elapses — **non-blocking**: backoff is a timestamp the
+  next tick compares against, never a sleep, so one flapping child
+  cannot stall the owner's loop.
+- a child whose policy says give up (budget spent / flapping) parks in
+  ``given_up`` and stays down — the owner serves on at reduced width,
+  exactly like the elastic-degrade philosophy of PR 4.
+- ``kill()`` is the chaos surface: the ``serve.replica_kill`` fault
+  point (and tests) SIGKILL a child through it; the respawn path is
+  identical to an organic crash.
+
+Everything is plain ``subprocess`` + monotonic clocks; no threads of
+its own.  Chaos is disarmed in respawned children (``SPARKNET_CHAOS``
+cleared) for the same reason supervisor relaunches disarm it: a
+deterministic fault would re-fire forever and burn the budget on one
+injection.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .policy import CLEAN, Config, RestartPolicy, classify_exit
+
+# child lifecycle states
+RUNNING = "running"
+BACKOFF = "backoff"       # dead, respawn scheduled at next_spawn_t
+GIVEN_UP = "given_up"     # policy exhausted; stays down
+STOPPED = "stopped"       # pool.stop() took it down on purpose
+
+
+class Child:
+    """One supervised child slot (replica index is identity; the
+    process behind it changes across respawns)."""
+
+    __slots__ = (
+        "index", "name", "proc", "state", "policy", "spawn_count",
+        "next_spawn_t", "last_spawn_t", "last_exit", "give_up_reason",
+    )
+
+    def __init__(self, index: int, name: str, cfg: Config):
+        self.index = index
+        self.name = name
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = BACKOFF  # spawned by the first tick / start()
+        self.policy = RestartPolicy(cfg)
+        self.spawn_count = 0
+        self.next_spawn_t = 0.0
+        self.last_spawn_t: Optional[float] = None
+        self.last_exit: Optional[int] = None
+        self.give_up_reason: Optional[str] = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "name": self.name,
+            "state": self.state,
+            "pid": self.pid,
+            "spawns": self.spawn_count,
+            "last_exit": self.last_exit,
+            "give_up_reason": self.give_up_reason,
+        }
+
+
+class ChildPool:
+    """Keep ``n`` independent children alive under per-child policy.
+
+    ``make_argv(index, spawn_count)`` builds the command for (re)spawn
+    ``spawn_count`` of child ``index`` — respawns can differ (a fresh
+    portfile path, a bumped generation).  ``make_env(index,
+    spawn_count)`` likewise (default: inherit, chaos disarmed on
+    respawns).  ``healthy_after_s``: a child alive this long counts as
+    a healthy run and resets its policy budget (the PR 4 semantics,
+    applied per child at exit time)."""
+
+    def __init__(
+        self,
+        make_argv: Callable[[int, int], List[str]],
+        n: int,
+        *,
+        config: Optional[Config] = None,
+        make_env: Optional[Callable[[int, int], Dict[str, str]]] = None,
+        name: str = "pool",
+        stdout=None,
+    ):
+        self.cfg = config or Config()
+        self.make_argv = make_argv
+        self.make_env = make_env
+        self.name = name
+        self.stdout = stdout
+        self.children = [
+            Child(i, f"{name}-{i}", self.cfg) for i in range(int(n))
+        ]
+        self.events: List[Dict[str, Any]] = []  # drained by the owner
+
+    # ------------------------------------------------------------------
+    def _env(self, child: Child) -> Dict[str, str]:
+        if self.make_env is not None:
+            env = dict(self.make_env(child.index, child.spawn_count))
+        else:
+            env = dict(os.environ)
+        if child.spawn_count > 0:
+            env["SPARKNET_CHAOS"] = ""  # respawns run chaos-disarmed
+        return env
+
+    def _spawn(self, child: Child) -> None:
+        argv = self.make_argv(child.index, child.spawn_count)
+        child.proc = subprocess.Popen(
+            argv,
+            env=self._env(child),
+            stdout=self.stdout,
+            stderr=subprocess.STDOUT if self.stdout is not None else None,
+        )
+        child.spawn_count += 1
+        child.last_spawn_t = time.monotonic()
+        child.state = RUNNING
+        self.events.append({
+            "event": "spawn", "child": child.index,
+            "spawn": child.spawn_count, "pid": child.proc.pid,
+        })
+
+    def start(self) -> "ChildPool":
+        for child in self.children:
+            if child.state == BACKOFF and child.proc is None:
+                self._spawn(child)
+        return self
+
+    # ------------------------------------------------------------------
+    def tick(self) -> List[Dict[str, Any]]:
+        """Poll every child once; respawn whatever is due.  Returns the
+        events since the last tick (spawn/exit/give_up), newest last —
+        the owner's log/metrics feed."""
+        now = time.monotonic()
+        for child in self.children:
+            if child.state == RUNNING:
+                rc = child.proc.poll()
+                if rc is None:
+                    continue
+                child.last_exit = rc
+                cls = classify_exit(rc)
+                self.events.append({
+                    "event": "exit", "child": child.index,
+                    "returncode": rc, "class": cls,
+                })
+                if (
+                    child.last_spawn_t is not None
+                    and now - child.last_spawn_t >= self.cfg.healthy_s
+                ):
+                    child.policy.note_healthy_run()
+                if cls == CLEAN:
+                    # a replica exiting cleanly chose to stop — an
+                    # operator action, not a failure; leave it down
+                    child.state = STOPPED
+                    continue
+                child.policy.note_failure(now)
+                verdict, backoff, why = child.policy.decide()
+                if verdict == "give_up":
+                    child.state = GIVEN_UP
+                    child.give_up_reason = why
+                    self.events.append({
+                        "event": "give_up", "child": child.index,
+                        "why": why,
+                    })
+                else:
+                    child.state = BACKOFF
+                    child.next_spawn_t = now + backoff
+            elif child.state == BACKOFF and now >= child.next_spawn_t:
+                self._spawn(child)
+        out, self.events = self.events, []
+        return out
+
+    # ------------------------------------------------------------------
+    def kill(self, index: int, sig: int = signal.SIGKILL) -> bool:
+        """Kill child ``index`` (the chaos surface; recovery is the
+        ordinary tick respawn path).  False when it isn't running."""
+        child = self.children[index]
+        if child.state != RUNNING or child.proc is None:
+            return False
+        try:
+            child.proc.send_signal(sig)
+        except OSError:
+            return False
+        return True
+
+    def alive(self) -> List[int]:
+        return [
+            c.index for c in self.children
+            if c.state == RUNNING and c.proc is not None
+            and c.proc.poll() is None
+        ]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "children": [c.snapshot() for c in self.children],
+            "alive": len(self.alive()),
+        }
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        """Terminate every child (TERM, then KILL past the grace)."""
+        for child in self.children:
+            if child.proc is not None and child.proc.poll() is None:
+                child.proc.terminate()
+        deadline = time.monotonic() + grace_s
+        for child in self.children:
+            if child.proc is None:
+                continue
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                child.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                child.proc.kill()
+                child.proc.wait(timeout=10.0)
+            child.state = STOPPED

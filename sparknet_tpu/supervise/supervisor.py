@@ -213,32 +213,33 @@ class Supervisor:
         counted) before any child pays a backend init.  Returns
         ``(iter, path)`` or None (fresh start)."""
         from ..solver.snapshot import (
-            SnapshotError,
-            load_state,
+            newest_verified_solverstate,
             ordered_solverstates,
         )
 
         self._chaos_resume_torn(restart_index)
         if not self.snapshot_prefix:
             return None
-        candidates = ordered_solverstates(self.snapshot_prefix)
-        for it, path in candidates:
-            try:
-                load_state(path)
-            except SnapshotError as e:
-                METRICS.inc("torn_snapshots")
-                _log(f"snapshot {path} is torn ({e}); the relaunch will "
-                     f"fall back past it")
-                continue
-            except ValueError as e:
-                # version mismatch: valid file, wrong era — auto-resume
-                # would fail loudly on it too; report, don't mask
-                _log(f"snapshot {path} is unrestorable ({e})")
-                continue
+
+        def torn(path, e):
+            METRICS.inc("torn_snapshots")
+            _log(f"snapshot {path} is torn ({e}); the relaunch will "
+                 f"fall back past it")
+
+        def unrestorable(path, e):
+            # version mismatch: valid file, wrong era — auto-resume
+            # would fail loudly on it too; report, don't mask
+            _log(f"snapshot {path} is unrestorable ({e})")
+
+        resume = newest_verified_solverstate(
+            self.snapshot_prefix, on_torn=torn, on_unrestorable=unrestorable
+        )
+        if resume is not None:
             METRICS.inc("verified_resumes")
-            _log(f"verified resume point: iteration {it} ({path})")
-            return it, path
-        if candidates:
+            _log(f"verified resume point: iteration {resume[0]} "
+                 f"({resume[1]})")
+            return resume
+        if ordered_solverstates(self.snapshot_prefix):
             _log(
                 "WARNING: no intact solverstate under "
                 f"{self.snapshot_prefix!r} — the relaunch starts fresh "

@@ -174,6 +174,58 @@ def _share_table(shares, phases) -> str:
     )
 
 
+def _router_section(router: dict) -> str:
+    """Serving-tier tiles + per-replica table (router /dash only):
+    healthy count, served generations, retry/respawn counters, and one
+    row per replica — state, outstanding, generation, p50/p99."""
+    rm = router.get("router") or {}
+    lat = rm.get("request_latency") or {}
+    healthy = router.get("replicas_healthy", 0)
+    total = router.get("replicas_total", 0)
+    tiles = [
+        _tile("replicas", f"{healthy}/{total}",
+              "healthy" if healthy == total else "degraded"),
+        _tile("served gen", ",".join(
+            str(g) for g in router.get("generations", [])) or "0",
+            f"{rm.get('rolls', 0)} rolls"),
+        _tile("retries", str(rm.get("retries", 0)),
+              f"{rm.get('failed', 0)} failed"),
+        _tile("replica deaths", str(rm.get("replica_deaths", 0)),
+              f"{rm.get('respawns', 0)} respawns"),
+        _tile("router p99", (
+            f"{lat.get('p99_ms'):.1f} ms"
+            if lat.get("p99_ms") is not None else "—"
+        )),
+    ]
+    rows = []
+    for r in router.get("replicas", []):
+        st = "good" if r.get("healthy") else "serious"
+        label = "healthy" if r.get("healthy") else "ejected"
+        rl = r.get("latency") or {}
+        fmt = lambda v: f"{v:.1f}" if v is not None else "—"
+        rows.append(
+            f'<tr><td>replica {r.get("index")}</td>'
+            f'<td><span class="status-{st}">'
+            f'{"●" if r.get("healthy") else "▲"} {label}</span></td>'
+            f'<td>{_esc(r.get("addr") or "?")}</td>'
+            f'<td>{r.get("outstanding", 0)}</td>'
+            f'<td>{_esc(r.get("generation"))}</td>'
+            f'<td>{r.get("forwarded", 0)}</td>'
+            f'<td>{fmt(rl.get("p50_ms"))}</td>'
+            f'<td>{fmt(rl.get("p99_ms"))}</td></tr>'
+        )
+    table = (
+        '<table class="data"><thead><tr><th>replica</th><th>state</th>'
+        "<th>addr</th><th>outstanding</th><th>gen</th><th>forwarded</th>"
+        "<th>p50 ms</th><th>p99 ms</th></tr></thead>"
+        f'<tbody>{"".join(rows)}</tbody></table>'
+    )
+    return (
+        f'<section><h2>Serving tier</h2>'
+        f'<div class="tiles">{"".join(tiles)}</div>{table}</section>'
+    )
+
+
 def _anomaly_feed(events: List[dict]) -> str:
     if not events:
         return '<p class="muted">no anomalies recorded</p>'
@@ -220,9 +272,12 @@ def render_html(
     anomalies: Optional[List[dict]] = None,
     model_name: str = "net",
     refresh_s: int = 2,
+    router: Optional[dict] = None,
 ) -> str:
     """The whole dashboard as one HTML string, rendered server-side
-    from snapshots (the route passes live ones)."""
+    from snapshots (the route passes live ones).  ``router``: a
+    Router.snapshot() — adds the serving-tier section (replica table,
+    generations, retry counters) on the router's /dash."""
     cluster = cluster if cluster is not None else registry_snapshot.get(
         "cluster"
     )
@@ -264,6 +319,7 @@ def render_html(
   <span class="status-{status} pill">{'▲' if degraded else '●'} {status_label}</span>
   <span class="muted">rendered {time.strftime('%H:%M:%S')}, refreshes every {refresh_s}s</span>
 </header>
+{_router_section(router) if router is not None else ''}
 <section><h2>Serving</h2><div class="tiles">{''.join(tiles)}</div></section>
 <section><h2>Latency SLO <span class="muted">(p99 budget {budget:g} ms)</span></h2>
 <div class="tiles">{''.join(slo_tiles)}</div></section>

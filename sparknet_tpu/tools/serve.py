@@ -1,12 +1,30 @@
-"""serve — persistent batched inference over a deploy prototxt.
+"""serve — batched inference over a deploy prototxt, single process or
+a replicated tier.
 
 The caffe-era spelling of a model server: point it at a zoo deploy
 net plus trained weights and it holds the compiled executables
 resident, micro-batching a request stream through them.
 
+    # one process (engine + batcher + HTTP)
     python -m sparknet_tpu.tools.serve \
         --model deploy.prototxt --weights model.npz --port 8080 \
-        [--buckets 1,8,32] [--max-latency-us 2000] [--max-queue 256]
+        [--buckets 1,8,32] [--batch-mode continuous|fill] \
+        [--compile-cache DIR] [--snapshot-watch TARGET] [--data-cache NS]
+
+    # the production shape: a front router over N replica processes
+    python -m sparknet_tpu.tools.serve \
+        --model deploy.prototxt --weights model.npz --port 8080 \
+        --replicas 2 --compile-cache /var/cache/sparknet \
+        --snapshot-watch runs/cifar/snap
+
+With ``--replicas N`` the process becomes a **router**
+(``serve/router.py``): it spawns N engine replicas (ephemeral ports,
+discovered via portfiles), load-balances ``/classify`` by least
+outstanding requests, retries a dying replica's in-flight requests on
+a peer, respawns dead replicas under per-replica restart budgets
+(``supervise/pool.py``), and rolls weight hot-swaps one replica at a
+time.  The HTTP surface is identical either way — clients cannot tell
+one process from a tier (docs/SERVING.md).
 
 Weights may be a ``.caffemodel``, a ``.npz`` WeightCollection, or a
 full ``.solverstate.npz`` training snapshot (params + BN stats are
@@ -19,97 +37,62 @@ from __future__ import annotations
 
 import argparse
 import json
-
-
-def _int_list(text: str):
-    vals = [int(v) for v in text.split(",") if v.strip()]
-    if not vals:
-        raise argparse.ArgumentTypeError(f"empty int list: {text!r}")
-    return vals
+import os
+import sys
 
 
 def main(argv=None):
     from ._common import honor_platform_env
 
     honor_platform_env()
+    from ..serve.replica import add_engine_args
+
     ap = argparse.ArgumentParser(
         prog="serve", description="batched deploy-net inference server"
     )
-    ap.add_argument("--model", required=True, help="deploy .prototxt")
-    ap.add_argument(
-        "--weights",
-        default=None,
-        help=".caffemodel | .npz | .solverstate.npz",
-    )
+    add_engine_args(ap)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument(
-        "--buckets",
-        type=_int_list,
-        default=[1, 8, 32],
-        help="batch-size buckets to pre-compile (requests pad up)",
+        "--replicas", type=int, default=0, metavar="N",
+        help="run as a router over N engine-replica child processes "
+             "(0: single process)",
     )
     ap.add_argument(
-        "--max-batch",
-        type=int,
-        default=0,
-        help="rows per engine call (default: largest bucket)",
+        "--run-dir", default=None,
+        help="router mode: where portfiles/logs land (default: a "
+             "temp dir)",
     )
     ap.add_argument(
-        "--max-latency-us",
-        type=int,
-        default=2000,
-        help="longest a request waits for batch co-riders",
+        "--health-interval-s", type=float, default=0.5,
+        help="router health-sweep cadence",
     )
     ap.add_argument(
-        "--max-queue",
-        type=int,
-        default=256,
-        help="queued-request bound (backpressure -> HTTP 503)",
+        "--portfile", default=None,
+        help="publish the bound address (JSON) — lets scripts find an "
+             "ephemeral --port 0",
     )
-    ap.add_argument("--top-k", type=int, default=5)
-    ap.add_argument("--bf16", action="store_true")
     ap.add_argument(
-        "--bench",
-        type=int,
-        default=0,
-        metavar="N",
+        "--bench", type=int, default=0, metavar="N",
         help="offline mode: run the closed-loop load generator for N "
-        "requests and print one JSON record instead of serving",
+             "requests and print one JSON record instead of serving",
     )
     ap.add_argument("--bench-concurrency", type=int, default=4)
     ap.add_argument(
         "--bench-sizes",
-        type=_int_list,
+        type=lambda t: [int(v) for v in t.split(",") if v.strip()],
         default=[1, 2, 5, 8, 3],
         help="request row-counts the load generator cycles through",
     )
     args = ap.parse_args(argv)
 
-    import jax.numpy as jnp
+    if args.replicas > 0:
+        return _run_router(args)
 
-    from ..serve.batcher import MicroBatcher
-    from ..serve.engine import InferenceEngine
     from ..serve.loadgen import run_loadgen
-    from ..serve.metrics import ServeMetrics
-    from ..serve.server import InferenceServer
+    from ..serve.replica import build_stack, write_portfile
 
-    metrics = ServeMetrics(args.buckets)
-    engine = InferenceEngine.from_files(
-        args.model,
-        args.weights,
-        buckets=args.buckets,
-        compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-        metrics=metrics,
-    )
-    engine.warmup()
-    batcher = MicroBatcher(
-        engine,
-        max_batch=args.max_batch,
-        max_latency_us=args.max_latency_us,
-        max_queue=args.max_queue,
-        metrics=metrics,
-    )
+    engine, batcher, metrics, server = build_stack(args)
 
     if args.bench:
         record = run_loadgen(
@@ -124,21 +107,101 @@ def main(argv=None):
         print(json.dumps(record))
         return record
 
-    server = InferenceServer(
-        engine,
-        batcher=batcher,
-        metrics=metrics,
-        host=args.host,
-        port=args.port,
-        model_name=args.model,
-        default_top_k=args.top_k,
-    )
+    if args.portfile:
+        write_portfile(args.portfile, server, engine,
+                       server.compile_cache_info)
     print(
         f"serving {args.model} on http://{server.host}:{server.port} "
-        f"(buckets={engine.buckets}, max_latency_us={args.max_latency_us})"
+        f"(buckets={engine.buckets}, mode={args.batch_mode}, "
+        f"max_latency_us={args.max_latency_us})"
     )
     server.serve_forever()
     return server
+
+
+def _replica_argv(args, run_dir: str, index: int, spawn: int):
+    """The child command for replica ``index``, spawn ``spawn`` — a
+    fresh portfile per spawn so the router can tell a respawn's port
+    from its predecessor's."""
+    argv = [
+        sys.executable, "-m", "sparknet_tpu.serve.replica",
+        "--model", args.model,
+        "--buckets", ",".join(str(b) for b in args.buckets),
+        "--max-batch", str(args.max_batch),
+        "--max-latency-us", str(args.max_latency_us),
+        "--max-queue", str(args.max_queue),
+        "--batch-mode", args.batch_mode,
+        "--top-k", str(args.top_k),
+        "--port", "0",
+        "--portfile", _portfile(run_dir, index, spawn),
+    ]
+    if args.weights:
+        argv += ["--weights", args.weights]
+    if args.bf16:
+        argv.append("--bf16")
+    if args.compile_cache:
+        argv += ["--compile-cache", args.compile_cache]
+    if args.data_cache:
+        argv += ["--data-cache", args.data_cache]
+    # NOTE: --snapshot-watch is deliberately NOT forwarded — under a
+    # router the roll is router-driven, one replica at a time
+    return argv
+
+
+def _portfile(run_dir: str, index: int, spawn: int) -> str:
+    return os.path.join(run_dir, f"replica-{index}-s{spawn}.json")
+
+
+def _run_router(args):
+    import tempfile
+
+    from ..serve.replica import write_portfile
+    from ..serve.router import Router
+    from ..supervise.pool import ChildPool
+
+    run_dir = args.run_dir or tempfile.mkdtemp(prefix="sparknet_serve_")
+    os.makedirs(run_dir, exist_ok=True)
+    pool = ChildPool(
+        lambda i, s: _replica_argv(args, run_dir, i, s),
+        args.replicas,
+        name="serve-replica",
+    )
+    router = Router(
+        args.replicas,
+        pool=pool,
+        portfile_for=lambda i, s: _portfile(run_dir, i, s),
+        host=args.host,
+        port=args.port,
+        model_name=os.path.basename(args.model),
+        health_interval_s=args.health_interval_s,
+        watch=args.snapshot_watch,
+    )
+    pool.start()
+    router.start()
+    if args.portfile:
+        # reuse the replica portfile shape; the router has no engine
+        write_portfile(
+            args.portfile, router,
+            type("E", (), {"warmup_s": None, "generation": 0})(), None,
+        )
+    ok = router.wait_healthy(timeout_s=300.0)
+    print(
+        f"router on http://{router.host}:{router.port} — "
+        f"{len(pool.alive())}/{args.replicas} replicas "
+        f"{'healthy' if ok else 'NOT all healthy'} "
+        f"(run_dir={run_dir})",
+        flush=True,
+    )
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+    return router
 
 
 if __name__ == "__main__":
