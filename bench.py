@@ -21,6 +21,11 @@ Env knobs:
                              none/bf16/int8 compression, with bucket
                              histogram, bytes-on-wire estimate and the
                              --tau auto controller trajectory
+  BENCH_MODEL=sharding       sharding-path A/B (PR 10): legacy explicit
+                             shard_map dp vs the unified rule-table/
+                             NamedSharding step on the virtual-CPU mesh
+                             (step ms, compile wall time, donated-buffer
+                             peak-memory estimate)
   BENCH_MODEL=input_pipeline host preprocessing A/B (PR 2)
   BENCH_MODEL=data_plane     packed-record data-plane A/B (PR 8):
                              legacy in-memory feed vs packed shard
@@ -948,6 +953,106 @@ def bench_comm(platform: str) -> dict:
     }
 
 
+def bench_sharding(platform: str) -> dict:
+    """Sharding-path A/B (``BENCH_MODEL=sharding``): legacy explicit
+    shard_map dp (the bucketed program, PR 6) vs the unified
+    NamedSharding/GSPMD dp step (parallel/partition.py) on the
+    virtual-CPU mesh — step ms, compile count, compile wall time and a
+    donated-buffer peak-memory estimate per arm, the ISSUE 10 fields
+    ``scripts/bench_diff.py`` reads back.
+
+    The memory figure is an analytic model, not a measurement: live
+    bytes = params + opt slots + net state; a non-donating step would
+    double that transiently (XLA must materialize the outputs before
+    releasing the inputs), donation lets XLA alias them — so
+    ``donated_peak_mb`` ≈ live + batch, vs ``undonated_peak_mb`` ≈
+    2×live + batch."""
+    from sparknet_tpu.parallel import (
+        CommConfig, ParallelSolver, make_mesh, parse_layout, partition,
+    )
+    from sparknet_tpu.proto import caffe_pb
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    sp = caffe_pb.load_solver(
+        os.path.join(zoo, "cifar10_quick_solver.prototxt")
+    )
+    ndev = len(jax.devices())
+    bs = int(os.environ.get("BENCH_BATCH", 4 * ndev))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    shapes = {"data": (bs, 32, 32, 3), "label": (bs,)}
+    rng = np.random.default_rng(0)
+    batch = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(bs,)), jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield batch
+
+    def tree_mb(*trees):
+        return sum(
+            x.size * x.dtype.itemsize
+            for t in trees
+            for x in jax.tree_util.tree_leaves(t)
+        ) / 1e6
+
+    def run_arm(make_solver):
+        t0 = time.perf_counter()
+        solver = make_solver()
+        # first step = trace + XLA compile (the arm's one program)
+        partition.fence_once(solver.step(feed(), 1))
+        compile_s = time.perf_counter() - t0
+        partition.fence_once(solver.step(feed(), 2))  # warm
+        t1 = time.perf_counter()
+        m = solver.step(feed(), iters)
+        partition.fence_once(m)
+        step_ms = 1e3 * (time.perf_counter() - t1) / iters
+        live_mb = tree_mb(solver.params, solver.opt_state, solver.state)
+        batch_mb = tree_mb(batch)
+        return solver, {
+            "step_ms": round(step_ms, 3),
+            "compile_count": 1,
+            "compile_s": round(compile_s, 3),
+            "loss": round(float(m["loss"]), 5),
+            "live_mb": round(live_mb, 3),
+            "donated_peak_mb": round(live_mb + batch_mb, 3),
+            "undonated_peak_mb": round(2 * live_mb + batch_mb, 3),
+        }
+
+    # legacy arm: the explicit shard_map dp program (bucketed comm path)
+    _, legacy = run_arm(lambda: ParallelSolver(
+        sp, shapes, solver_dir=zoo, mesh=make_mesh(), mode="sync",
+        comm_config=CommConfig(mode="bucketed"),
+    ))
+    # unified arm: rule-table layout through make_sharded_train_step
+    uni_solver, unified = run_arm(lambda: ParallelSolver(
+        sp, shapes, solver_dir=zoo,
+        layout=parse_layout(f"dp={ndev}", rules="replicated"),
+    ))
+    rep = uni_solver.layout_report()
+    return {
+        "metric": "sharding_unified_step_ms",
+        "value": unified["step_ms"],
+        "unit": "ms/step",
+        "vs_baseline": None,
+        "platform": platform,
+        "devices": ndev,
+        "batch_size": bs,
+        "iters": iters,
+        "unified_step_ms": unified["step_ms"],
+        "legacy_step_ms": legacy["step_ms"],
+        "unified_speedup": round(
+            legacy["step_ms"] / max(unified["step_ms"], 1e-9), 3
+        ),
+        "compile_s_unified": unified["compile_s"],
+        "compile_s_legacy": legacy["compile_s"],
+        "donated_peak_mb": unified["donated_peak_mb"],
+        "layout": rep,
+        "arms": {"legacy_shard_map": legacy, "unified_named_sharding": unified},
+    }
+
+
 def bench_bert(platform: str) -> dict:
     from sparknet_tpu.data.text import mlm_dataset, mlm_feed
     from sparknet_tpu.models.bert import BertConfig, BertMLM
@@ -1034,7 +1139,7 @@ def main() -> None:
 
     honor_platform_env()
     mode = os.environ.get("BENCH_MODEL", "alexnet")
-    if mode == "comm" and not os.environ.get("BENCH_COMM_NATIVE"):
+    if mode in ("comm", "sharding") and not os.environ.get("BENCH_COMM_NATIVE"):
         # the comm A/B needs a mesh; the tunnel exposes one chip — run
         # on 8 virtual CPU devices (same device-forcing recipe as the
         # driver's dryrun_multichip) BEFORE any backend init
@@ -1047,6 +1152,8 @@ def main() -> None:
         runner = bench_bert
     elif mode == "comm":
         runner = bench_comm
+    elif mode == "sharding":
+        runner = bench_sharding
     elif mode == "input_pipeline":
         runner = bench_input_pipeline
     elif mode == "data_plane":
@@ -1060,7 +1167,7 @@ def main() -> None:
         # Exception and still emits the JSON error record
         raise ValueError(
             f"BENCH_MODEL={mode!r}: want "
-            f"bert|input_pipeline|data_plane|comm|serving_tier|"
+            f"bert|input_pipeline|data_plane|comm|sharding|serving_tier|"
             f"{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
@@ -1100,6 +1207,8 @@ if __name__ == "__main__":
                         if mode == "input_pipeline"
                         else "comm_round_ms_bucketed_vs_monolithic"
                         if mode == "comm"
+                        else "sharding_unified_step_ms"
+                        if mode == "sharding"
                         else "data_plane_cached_rows_per_sec"
                         if mode == "data_plane"
                         else "serving_tier_p99_ms_continuous"

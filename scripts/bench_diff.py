@@ -139,9 +139,21 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
             rise = (b - a) / a
             add(key, a, b, "", rise > args.throughput_pct / 100.0,
                 f"{rise:+.1%}")
-    # ratio fields, higher is better: continuous-vs-fill p99 win and
-    # the compile cache's warm-restart warmup speedup
-    for key in ("p99_improvement", "warm_restart_speedup"):
+    # sharding records (BENCH_MODEL=sharding): unified-vs-legacy step
+    # time, compile wall time, and the donated-buffer peak-memory
+    # estimate — all lower-is-better
+    for key in ("unified_step_ms", "legacy_step_ms", "compile_s_unified",
+                "compile_s_legacy", "donated_peak_mb"):
+        a, b = find_key(old, key), find_key(new, key)
+        if a and b:
+            rise = (b - a) / a
+            add(key, a, b, "", rise > args.throughput_pct / 100.0,
+                f"{rise:+.1%}")
+    # ratio fields, higher is better: continuous-vs-fill p99 win, the
+    # compile cache's warm-restart warmup speedup, and the unified
+    # sharding path's step-time win over the legacy shard_map program
+    for key in ("p99_improvement", "warm_restart_speedup",
+                "unified_speedup"):
         a, b = find_key(old, key), find_key(new, key)
         if a and b:
             drop = (a - b) / a

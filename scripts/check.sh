@@ -178,6 +178,86 @@ else
   exit 1
 fi
 
+# ---- sharding smoke (ISSUE 10): 5 CPU train iters through the unified
+# rule-table path (--layout dp=2,tp=2) on a 2×2 virtual-CPU mesh must
+# print the layout: line (mesh + rule + sharded-leaf record), match a
+# single-device run to reduction-order accuracy (GSPMD partitioning is
+# semantics-preserving; cross-partitioning equality is ulp-level — the
+# BITWISE bar for identical shardings is pinned in tests/test_partition
+# .py), and a REPEAT unified run must be bitwise-identical (the
+# compiled path is deterministic).
+SH_DIR=$(mktemp -d /tmp/_sharding_smoke.XXXXXX)
+SH_LOG="$SH_DIR/smoke.log"
+cat > "$SH_DIR/net.prototxt" <<'EOF'
+name: "sharding_smoke"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+EOF
+sh_solver() {
+  cat > "$SH_DIR/solver_$1.prototxt" <<EOF
+net: "net.prototxt"
+base_lr: 0.01
+lr_policy: "fixed"
+max_iter: 5
+display: 0
+snapshot: 5
+snapshot_prefix: "$SH_DIR/w_$1"
+EOF
+}
+sh_solver single; sh_solver uni; sh_solver uni2
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m sparknet_tpu.tools.caffe train \
+      "--solver=$SH_DIR/solver_single.prototxt" --synthetic --synthetic-n=64 \
+      --batch-size=8 --data-workers=0 --native-loader=off >> "$SH_LOG" 2>&1 \
+  && timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+      python -m sparknet_tpu.tools.caffe train \
+      "--solver=$SH_DIR/solver_uni.prototxt" --synthetic --synthetic-n=64 \
+      --batch-size=8 --data-workers=0 --native-loader=off \
+      --layout=dp=2,tp=2 > "$SH_DIR/uni.log" 2>&1 \
+  && grep -q '^layout: {' "$SH_DIR/uni.log" \
+  && timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+      python -m sparknet_tpu.tools.caffe train \
+      "--solver=$SH_DIR/solver_uni2.prototxt" --synthetic --synthetic-n=64 \
+      --batch-size=8 --data-workers=0 --native-loader=off \
+      --layout=dp=2,tp=2 >> "$SH_LOG" 2>&1 \
+  && python - "$SH_DIR" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+line = [l for l in open(f"{d}/uni.log") if l.startswith("layout: ")][-1]
+rep = json.loads(line[len("layout: "):])
+assert rep["mesh"] == {"dp": 2, "tp": 2}, rep
+assert rep["path"] == "unified" and rep["sharded"] >= 1, rep
+a = np.load(f"{d}/w_single_iter_5.npz")
+b = np.load(f"{d}/w_uni_iter_5.npz")
+c = np.load(f"{d}/w_uni2_iter_5.npz")
+for k in a.files:
+    assert (b[k] == c[k]).all(), f"unified run not deterministic at {k}"
+    if a[k].dtype.kind == "f":
+        assert np.allclose(a[k], b[k], rtol=1e-5, atol=1e-6), (
+            f"unified vs single-device weights differ at {k}: "
+            f"max {np.abs(a[k] - b[k]).max()}"
+        )
+    else:
+        assert (a[k] == b[k]).all(), k
+print(f"sharding smoke: layout {rep['mesh']} sharded={rep['sharded']}/"
+      f"{rep['param_leaves']}, weights match single-device")
+EOF
+then
+  echo "check.sh: sharding smoke OK (unified dp=2,tp=2 == single device, layout line present)"
+  rm -rf "$SH_DIR"
+else
+  echo "check.sh: sharding SMOKE FAILED — log tails:"
+  tail -15 "$SH_LOG"
+  tail -15 "$SH_DIR/uni.log" 2>/dev/null
+  exit 1
+fi
+
 # ---- data-plane smoke (ISSUE 8): pack a tiny synthetic dataset, train
 # 5 CPU iters three ways — legacy in-memory feed, packed shard readers
 # cold (filling the decoded-batch cache), and packed again served from

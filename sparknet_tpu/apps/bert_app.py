@@ -117,7 +117,7 @@ def build(args):
     nproc = jax.process_count()
     feed_bs = bs
     if nproc > 1:
-        if args.parallel == "none":
+        if args.parallel == "none" and not getattr(args, "layout", None):
             raise ValueError("multi-host launch requires --parallel sync|local")
         if bs % nproc:
             raise ValueError(f"batch ({bs}) must divide across {nproc} processes")
@@ -135,12 +135,26 @@ def build(args):
         attention_impl=args.attention or None,
     )
     sp = make_solver_param(args)
-    if args.parallel == "none":
+    layout_spec = getattr(args, "layout", None)
+    if args.parallel == "none" and not layout_spec:
         if getattr(args, "grad_compress", None):
             raise ValueError(
                 "--grad-compress requires --parallel sync|local"
             )
         solver = Solver(sp, shapes, model=model, seed=args.seed)
+    elif layout_spec:
+        from .cifar_app import comm_config_from
+
+        # unified rule-table path: the "bert" ruleset (Megatron
+        # column/row split + expert stacks) resolves against whatever
+        # axes the layout names — dp=2,tp=2 and dp=2,ep=4 are the same
+        # model, different table entries (docs/PARALLELISM.md)
+        solver = ParallelSolver(
+            sp, shapes, model=model, seed=args.seed,
+            layout=layout_spec,
+            mode="local" if args.parallel == "local" else "sync",
+            tau=args.tau, comm_config=comm_config_from(args),
+        )
     else:
         from .cifar_app import comm_config_from
 
@@ -350,6 +364,12 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--mesh", default="",
                     help="axis spec for tp/sp/pp/ep, e.g. dp=2,tp=2,sp=2 "
                          "(one size may be -1 = all remaining devices)")
+    ap.add_argument("--layout", default=None, metavar="AXES",
+                    help="unified sharding layout for the Solver path, "
+                         "e.g. dp=2,tp=2: the 'bert' regex rule table "
+                         "maps params to PartitionSpecs and one GSPMD "
+                         "jit program replaces the per-mode step "
+                         "builders (docs/PARALLELISM.md)")
     ap.add_argument("--pp-microbatches", type=int, default=2)
     ap.add_argument("--tau", default="10",
                     help="local-SGD sync period: an integer or 'auto' "
@@ -488,7 +508,14 @@ def main(argv=None) -> Dict[str, float]:
             print("cluster: phase table (per-rank shares of loop wall time)")
             for line in agg.table().splitlines():
                 print(f"  {line}")
-        # comm/tau record lines, same discipline as cifar_app.train_loop
+        # layout/comm/tau record lines, same discipline as
+        # cifar_app.train_loop
+        if getattr(solver, "layout_report", None):
+            import json as _json
+
+            lrep = solver.layout_report()
+            if lrep:
+                print(f"layout: {_json.dumps(lrep)}")
         if hasattr(solver, "comm_report"):
             import json as _json
 

@@ -321,7 +321,8 @@ def build(args) -> tuple:
         seed=args.seed,
     )
     parallel = getattr(args, "parallel", "none")
-    if parallel == "none":
+    layout_spec = getattr(args, "layout", None)
+    if parallel == "none" and not layout_spec:
         if nproc > 1:
             raise ValueError("multi-host launch requires --parallel sync|local")
         if getattr(args, "grad_compress", None):
@@ -331,6 +332,17 @@ def build(args) -> tuple:
                 "--grad-compress requires --parallel sync|local"
             )
         solver = Solver(sp, shapes, **kw)
+    elif layout_spec:
+        # unified rule-table path (docs/PARALLELISM.md): the layout IS
+        # the parallelism — dp/tp/ep shapes are table entries, and
+        # --parallel local keeps τ-local SGD over a dp-only layout
+        solver = ParallelSolver(
+            sp, shapes,
+            layout=layout_spec,
+            mode="local" if parallel == "local" else "sync",
+            tau=getattr(args, "tau", 1),
+            comm_config=comm_config_from(args), **kw
+        )
     else:
         solver = ParallelSolver(
             sp, shapes, mesh=make_mesh(), mode=parallel,
@@ -561,6 +573,15 @@ def train_loop(
     # and supervisor: lines) and, under --tau auto, the controller's
     # decision log as a `tau:` line + a machine-readable report next to
     # the snapshots (docs/COMMUNICATION.md)
+    # layout record (unified sharding path): mesh shape, rule count,
+    # sharded/replicated leaf split and the layout fingerprint — one
+    # `layout:` JSON line, same discipline as comm:/chaos:
+    if getattr(solver, "layout_report", None):
+        import json as _json
+
+        lrep = solver.layout_report()
+        if lrep:
+            log(f"layout: {_json.dumps(lrep)}")
     if hasattr(solver, "comm_report"):
         import json as _json
 
@@ -647,6 +668,13 @@ def arg_parser() -> argparse.ArgumentParser:
                          "SPARKNET_CACHE_MB; docs/DATA.md)")
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
+    ap.add_argument("--layout", default=None, metavar="AXES",
+                    help="unified sharding layout, e.g. dp=2,tp=2: one "
+                         "mesh + the regex partition rule table replaces "
+                         "the per-strategy trainers — any dp×tp×ep shape "
+                         "is a table entry (combine with --parallel local "
+                         "for τ-local SGD over a dp-only layout; "
+                         "docs/PARALLELISM.md)")
     ap.add_argument("--tau", default="10",
                     help="local-SGD sync period (the SparkNet τ knob): "
                          "an integer, or 'auto' for the telemetry-"

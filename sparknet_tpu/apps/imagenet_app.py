@@ -157,7 +157,7 @@ def build(args):
     nproc = jax.process_count()
     feed_train_bs, feed_test_bs = train_bs, test_bs
     if nproc > 1:
-        if args.parallel == "none":
+        if args.parallel == "none" and not getattr(args, "layout", None):
             raise ValueError("multi-host launch requires --parallel sync|local")
         if train_bs % nproc or test_bs % nproc:
             raise ValueError(
@@ -203,7 +203,8 @@ def build(args):
         remat=getattr(args, "remat", False),
     )
     device_augment = getattr(args, "device_augment", False)
-    if args.parallel == "none":
+    layout_spec = getattr(args, "layout", None)
+    if args.parallel == "none" and not layout_spec:
         if device_augment:
             kw["batch_transform"] = train_tf.device_fn()
         if getattr(args, "grad_compress", None):
@@ -217,10 +218,17 @@ def build(args):
                 "--device-augment currently requires --parallel none "
                 "(the parallel solvers build their own train steps)"
             )
-        solver = ParallelSolver(
-            sp, shapes, mesh=make_mesh(), mode=args.parallel, tau=args.tau,
-            comm_config=comm_config_from(args), **kw
-        )
+        if layout_spec:
+            solver = ParallelSolver(
+                sp, shapes, layout=layout_spec,
+                mode="local" if args.parallel == "local" else "sync",
+                tau=args.tau, comm_config=comm_config_from(args), **kw
+            )
+        else:
+            solver = ParallelSolver(
+                sp, shapes, mesh=make_mesh(), mode=args.parallel,
+                tau=args.tau, comm_config=comm_config_from(args), **kw
+            )
     if getattr(args, "weights", None):
         solver.load_weights(args.weights)  # Caffe --weights finetuning
     if device_augment:
@@ -266,6 +274,9 @@ def parser() -> argparse.ArgumentParser:
     ap.add_argument("--synthetic-classes", type=int, default=1000)
     ap.add_argument("--max-iter", type=int, default=0)
     ap.add_argument("--batch-size", type=int, default=0)
+    ap.add_argument("--layout", default=None, metavar="AXES",
+                    help="unified sharding layout, e.g. dp=2,tp=2 "
+                         "(regex partition rule table; docs/PARALLELISM.md)")
     ap.add_argument("--parallel", choices=("none", "sync", "local"),
                     default="none")
     ap.add_argument("--grad-compress", choices=("none", "bf16", "int8"),

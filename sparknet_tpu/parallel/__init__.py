@@ -28,13 +28,34 @@ from .local_sgd import (
     round_batch_sharding,
     stack_round_batches,
 )
+from .partition import (
+    Layout,
+    Rule,
+    RULESETS,
+    layout_from_json,
+    layout_to_json,
+    make_plan,
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    parse_layout,
+)
 from .tau_controller import TauController
 from .trainer import ParallelSolver
-from . import comm, multihost
+from . import comm, multihost, partition
 
 __all__ = [
     "comm",
     "multihost",
+    "partition",
+    "Layout",
+    "Rule",
+    "RULESETS",
+    "layout_from_json",
+    "layout_to_json",
+    "make_plan",
+    "make_sharded_eval_step",
+    "make_sharded_train_step",
+    "parse_layout",
     "DP_AXIS",
     "PP_AXIS",
     "SP_AXIS",

@@ -71,6 +71,8 @@ def make_dp_train_step(
         return make_bucketed_dp_train_step(
             net, sp, mesh, config, dp_axis, donate
         )
+    from . import partition
+
     repl = replicated(mesh)
     if sp.iter_size > 1:
         # gradient accumulation stacks micro-batches on a leading axis
@@ -78,13 +80,15 @@ def make_dp_train_step(
         bsh = NamedSharding(mesh, P(None, dp_axis))
     else:
         bsh = batch_sharding(mesh, dp_axis)
-    kw = step_compile_kw()
-    return jax.jit(
+    # pure dp is the empty rule table: params/state/opt replicated,
+    # batch dp-sharded — compiled through the SAME jit wrapper as every
+    # rule-table layout (parallel/partition.py), so sync-DP and the
+    # unified path cannot drift
+    return partition.jit_sharded_step(
         make_train_step(net, sp),
         in_shardings=(repl, repl, repl, bsh, repl, repl),
         out_shardings=(repl, repl, repl, repl),
         donate_argnums=(0, 1, 2) if donate else (),
-        **kw,
     )
 
 
@@ -189,11 +193,12 @@ def make_bucketed_dp_train_step(
 
 
 def make_dp_eval_step(net: XLANet, mesh: Mesh, dp_axis: str = DP_AXIS) -> Callable:
+    from . import partition
+
     repl = replicated(mesh)
     bsh = batch_sharding(mesh, dp_axis)
-    return jax.jit(
+    return partition.jit_sharded_step(
         make_eval_step(net),
         in_shardings=(repl, repl, bsh),
         out_shardings=repl,
-        **step_compile_kw(),
     )

@@ -435,11 +435,12 @@ def make_sp_train_step(model, sp, mesh, dp_axis: str = "dp", sp_axis: str = "sp"
         "mlm_labels": P(dp_axis, sp_axis),
         "mlm_weights": P(dp_axis, sp_axis),
     }
-    step = jax.shard_map(
+    from . import comm
+
+    step = comm.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(), batch_spec, P(), P()),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
-    return jax.jit(step, donate_argnums=(0, 1))
+    return comm.jit_manual(step, donate_argnums=(0, 1))

@@ -48,6 +48,7 @@ from ..telemetry import anomaly as _anomaly
 from .mesh import DP_AXIS, batch_sharding, make_mesh, replicate
 from .tau_controller import TauController, parse_tau
 from . import multihost
+from . import partition as partition_mod
 
 
 class ParallelSolver(Solver):
@@ -61,8 +62,19 @@ class ParallelSolver(Solver):
         tau=1,
         dp_axis: str = DP_AXIS,
         comm_config: Optional[comm_mod.CommConfig] = None,
+        layout: Optional[Any] = None,
+        layout_rules: str = "auto",
         **kw: Any,
     ):
+        """``layout``: a :class:`~sparknet_tpu.parallel.partition.Layout`
+        (or a ``"dp=2,tp=2"`` axes string resolved against
+        ``layout_rules`` — ``"auto"`` picks the ``"bert"`` ruleset for
+        model-protocol nets and ``"tp"`` for prototxt nets).  With a
+        layout, sync training compiles through the unified
+        rule-table/NamedSharding path (parallel/partition.py): any
+        dp×tp×ep combination is a table entry, no new step builder.
+        ``mode="local"`` (τ-local SGD) and bucketed/compressed sync
+        comm remain dp-only and accept only dp-shaped layouts."""
         if kw.get("batch_transform") is not None:
             # the parallel modes build their own train steps below,
             # which would silently drop the transform — reject, per the
@@ -71,8 +83,17 @@ class ParallelSolver(Solver):
                 "batch_transform (device-side augmentation) is not "
                 "supported by ParallelSolver — use the base Solver"
             )
+        if isinstance(layout, str):
+            rules = layout_rules
+            if rules == "auto":
+                rules = "bert" if kw.get("model") is not None else "tp"
+            layout = partition_mod.parse_layout(layout, rules=rules)
+        self.layout: Optional[partition_mod.Layout] = layout
+        self._plan: Optional[partition_mod.Plan] = None
         super().__init__(solver, input_shapes, **kw)
-        self.mesh = mesh if mesh is not None else make_mesh()
+        if mesh is None:
+            mesh = layout.mesh() if layout is not None else make_mesh()
+        self.mesh = mesh
         self.mode = mode
         self.comm = (
             comm_config if comm_config is not None
@@ -102,7 +123,7 @@ class ParallelSolver(Solver):
             n_rounds = -(-max(1, solver.average_loss) // self.tau)
             self._loss_window = deque(maxlen=n_rounds)
         self.dp_axis = dp_axis
-        ndp = self.mesh.shape[dp_axis]
+        ndp = self.mesh.shape.get(dp_axis, 1)
         for which, xnet in (("train", self.train_net), ("test", self.test_net)):
             for name in xnet.input_names:
                 bs = xnet.blob_shapes[name][0]
@@ -111,30 +132,94 @@ class ParallelSolver(Solver):
                         f"{which} input {name!r}: batch {bs} not divisible "
                         f"by dp={ndp}"
                     )
-        self.params = replicate(self.params, self.mesh)
-        self.state = replicate(self.state, self.mesh)
+        if self.layout is not None:
+            non_dp = [
+                f"{a}={s}" for a, s in self.mesh.shape.items()
+                if a != dp_axis and s > 1
+            ]
+            if non_dp and mode == "local":
+                raise ValueError(
+                    "mode='local' (τ-local SGD averaging) is dp-only; "
+                    f"layout has non-trivial axes {non_dp} — use "
+                    "mode='sync' for model-parallel layouts"
+                )
+            if non_dp and (
+                self.comm.for_sync() == "bucketed" if mode == "sync" else False
+            ):
+                raise ValueError(
+                    "bucketed/compressed sync comm is an explicit dp "
+                    f"shard_map program; layout axes {non_dp} need the "
+                    "unified path — drop --grad-compress / "
+                    "SPARKNET_COMM=bucketed"
+                )
+            if mode == "sync" and self.comm.for_sync() != "bucketed":
+                self._plan = partition_mod.make_plan(
+                    self.layout, self.params, self.state, solver,
+                    mesh=self.mesh,
+                )
+            # snapshots carry the layout + per-leaf specs so a resume
+            # under a different layout warns and relayouts explicitly
+            self.env_meta["layout"] = partition_mod.layout_to_json(
+                self.layout
+            )
+            import json as _json
+
+            self.env_meta["param_specs"] = _json.dumps(
+                partition_mod.specs_record(
+                    self.params, self.layout.rules, self.mesh
+                ),
+                sort_keys=True,
+            )
+        if self._plan is not None:
+            self.params = partition_mod.place(
+                self.params, self._plan.params_sh
+            )
+            self.state = partition_mod.place(self.state, self._plan.state_sh)
+        else:
+            self.params = replicate(self.params, self.mesh)
+            self.state = replicate(self.state, self.mesh)
         # multi-host: each process feeds its local rows; _put_batch
         # assembles them into globally-sharded arrays
         self._multihost = jax.process_count() > 1
-        self._eval_sharding = batch_sharding(self.mesh, dp_axis)
-        if solver.iter_size > 1:
-            self._train_sharding = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec(None, dp_axis)
-            )
+        if self._plan is not None:
+            self._eval_sharding = self._plan.batch_eval_sh
+            self._train_sharding = self._plan.batch_train_sh
         else:
-            self._train_sharding = self._eval_sharding
-        if mode == "sync":
-            self.opt_state = replicate(self.opt_state, self.mesh)
-            if self.comm.for_sync() == "bucketed" and self.comm.wants_residual:
-                self.opt_state[RESIDUAL_KEY] = jax.device_put(
-                    init_local_residual(self.params, ndp),
-                    self._dp_sharding(),
+            self._eval_sharding = batch_sharding(self.mesh, dp_axis)
+            if solver.iter_size > 1:
+                self._train_sharding = jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(None, dp_axis)
                 )
-            self._train_step = make_dp_train_step(
-                self.train_net, solver, self.mesh, dp_axis,
-                config=self.comm,
-            )
-            self._eval_step = make_dp_eval_step(self.test_net, self.mesh, dp_axis)
+            else:
+                self._train_sharding = self._eval_sharding
+        if mode == "sync":
+            if self._plan is not None:
+                self.opt_state = partition_mod.place(
+                    self.opt_state, self._plan.opt_sh
+                )
+                self._train_step = partition_mod.make_sharded_train_step(
+                    self.train_net, solver, self._plan
+                )
+                self._eval_step = partition_mod.make_sharded_eval_step(
+                    self.test_net, self._plan
+                )
+            else:
+                self.opt_state = replicate(self.opt_state, self.mesh)
+                if (
+                    self.comm.for_sync() == "bucketed"
+                    and self.comm.wants_residual
+                ):
+                    self.opt_state[RESIDUAL_KEY] = jax.device_put(
+                        init_local_residual(self.params, ndp),
+                        self._dp_sharding(),
+                    )
+                self._train_step = make_dp_train_step(
+                    self.train_net, solver, self.mesh, dp_axis,
+                    config=self.comm,
+                )
+                self._eval_step = make_dp_eval_step(
+                    self.test_net, self.mesh, dp_axis
+                )
             comm_mod.count_reduction(self.comm, self.params, "sync_grads")
         elif mode == "local":
             if self.tau < 1:
@@ -179,6 +264,58 @@ class ParallelSolver(Solver):
         return jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
         )
+
+    def layout_report(self) -> Optional[Dict[str, Any]]:
+        """Machine-readable layout record for the apps' ``layout:``
+        line: mesh shape, rule count, sharded/replicated leaf counts
+        and the layout fingerprint (None without a layout)."""
+        if self.layout is None:
+            return None
+        if self._plan is not None:
+            out = self._plan.report()
+            out["path"] = "unified"
+            return out
+        out = {
+            "name": self.layout.name,
+            "mesh": dict(self.mesh.shape),
+            "rules": len(self.layout.rules),
+            "fingerprint": partition_mod.layout_fingerprint(self.layout),
+            "path": f"legacy-{self.mode}",
+        }
+        return out
+
+    def _env_drift_message(self, key, saved, cur) -> str:
+        if key == "param_specs":
+            return ""  # the layout key carries the aggregated notice
+        if key == "layout":
+            import json as _json
+
+            saved_name = "unknown"
+            try:
+                d = _json.loads(saved)
+                saved_name = f"{d.get('name')}:{dict(d.get('axes') or [])}"
+            except (TypeError, ValueError):
+                pass
+            cur_specs = (
+                self._plan.specs if self._plan is not None
+                else partition_mod.specs_record(
+                    self.params, self.layout.rules, self.mesh
+                )
+            )
+            saved_specs = str(
+                (getattr(self, "_restored_env", None) or {}).get(
+                    "param_specs", ""
+                )
+            )
+            return partition_mod.relayout_warning(
+                saved_specs,
+                cur_specs,
+                saved_layout=saved_name,
+                current_layout=(
+                    f"{self.layout.name}:{dict(self.mesh.shape)}"
+                ),
+            )
+        return super()._env_drift_message(key, saved, cur)
 
     def scan_steps(self, batch, n: int):
         """Not supported: the base implementation scans the
@@ -239,6 +376,18 @@ class ParallelSolver(Solver):
         return opt_state
 
     def _place_restored(self, params, state, opt_state):
+        if self._plan is not None:
+            # relayout-on-resume: leaves land wherever the RUN's rule
+            # table puts them, whatever the snapshot's layout was (the
+            # env-drift hook prints the aggregated warning)
+            if opt_state:
+                opt_state = self._reconcile_residual(opt_state)
+            return (
+                partition_mod.place(params, self._plan.params_sh),
+                partition_mod.place(state, self._plan.state_sh),
+                partition_mod.place(opt_state, self._plan.opt_sh)
+                if opt_state else opt_state,
+            )
         params = replicate(params, self.mesh)
         state = replicate(state, self.mesh)
         if opt_state:
@@ -264,7 +413,11 @@ class ParallelSolver(Solver):
         solver's layout instead."""
         from ..solver.caffe_solver import init_opt_state
 
-        ndp = self.mesh.shape[self.dp_axis]
+        ndp = self.mesh.shape.get(self.dp_axis, 1)
+        if self._plan is not None:
+            return partition_mod.place(
+                init_opt_state(self.sp, self.params), self._plan.opt_sh
+            )
         if self.mode == "sync":
             opt = replicate(init_opt_state(self.sp, self.params), self.mesh)
             if self._wants_residual():

@@ -37,14 +37,20 @@ import os
 from typing import Any, Dict, Optional
 
 
-def net_fingerprint(net, params: Any, state: Any, compute_dtype=None) -> str:
+def net_fingerprint(
+    net, params: Any, state: Any, compute_dtype=None, layout=None
+) -> str:
     """16-hex content hash of the net's *architecture* — stable across
     processes and weight versions, different for any structural change.
 
     Covers: layer (name, type, tops, bottoms), blob shapes, input
-    names, the param/state pytrees' paths + shapes + dtypes, and the
-    compute dtype.  Weight VALUES are deliberately excluded (see module
-    docstring)."""
+    names, the param/state pytrees' paths + shapes + dtypes, the
+    compute dtype, and (when serving through a multi-device
+    :class:`~sparknet_tpu.parallel.partition.Layout`) the layout
+    fingerprint — the same arch compiled under two different partition
+    rule tables produces different executables, so their compile
+    caches must never alias.  Weight VALUES are deliberately excluded
+    (see module docstring)."""
     import jax
 
     def tree_sig(tree):
@@ -70,6 +76,10 @@ def net_fingerprint(net, params: Any, state: Any, compute_dtype=None) -> str:
             if compute_dtype is not None else None
         ),
     }
+    if layout is not None:
+        from ..parallel import partition
+
+        doc["layout"] = partition.layout_fingerprint(layout)
     raw = json.dumps(doc, sort_keys=True).encode()
     return hashlib.sha256(raw).hexdigest()[:16]
 

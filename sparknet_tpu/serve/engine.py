@@ -84,12 +84,19 @@ class InferenceEngine:
         output: Optional[str] = None,
         compute_dtype: Any = jnp.float32,
         metrics=None,
+        layout=None,
     ):
         """``net``: an ``XLANet`` (any phase; TEST semantics are forced
         at apply time). ``output``: blob to return — defaults to the
         final layer's first top. ``metrics``: optional ``ServeMetrics``
         the engine reports per-bucket batch counts, padding waste and
-        device latency into."""
+        device latency into.  ``layout``: a
+        :class:`~sparknet_tpu.parallel.partition.Layout` for a
+        multi-device replica — weights land per the SAME rule-table
+        sharding trees training uses (one sharded compile path for
+        train and serve), request rows shard over the batch axis when
+        the bucket divides, and the fingerprint (hence both compile
+        caches) is keyed by the layout so layouts never alias."""
         if not buckets:
             raise ValueError("InferenceEngine: need at least one bucket")
         self.net = net
@@ -113,6 +120,13 @@ class InferenceEngine:
         self._row_shapes = {
             name: tuple(net.blob_shapes[name][1:]) for name in self.input_names
         }
+        self.layout = layout
+        self._mesh = None
+        if layout is not None:
+            from ..parallel import partition as _partition
+
+            self._partition = _partition
+            self._mesh = layout.mesh()
         self._cache: Dict[Tuple[str, int, str], Any] = {}
         self._compile_lock = threading.Lock()
         # weights state: swapped atomically under _swap_lock; infer()
@@ -130,10 +144,24 @@ class InferenceEngine:
         device arrays in, fingerprint recomputed — a structural change
         (different arch) changes the executable-cache key, so stale
         executables are unreachable by construction."""
-        to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
-        params, state = to_dev(params), to_dev(state)
+        if self._mesh is not None:
+            # per-leaf rule-table placement: the SAME sharding trees a
+            # training run with this layout uses (recomputed per swap —
+            # an arch change reshapes the trees)
+            lay = self.layout
+            self._params_sh = self._partition.sharding_tree(
+                params, lay.rules, self._mesh, lay.validate
+            )
+            self._state_sh = self._partition.sharding_tree(
+                state, lay.rules, self._mesh, lay.validate
+            )
+            params = self._partition.place(params, self._params_sh)
+            state = self._partition.place(state, self._state_sh)
+        else:
+            to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+            params, state = to_dev(params), to_dev(state)
         self.fingerprint = net_fingerprint(
-            self.net, params, state, self.compute_dtype
+            self.net, params, state, self.compute_dtype, layout=self.layout
         )
         self.params = params
         self.state = state
@@ -235,13 +263,30 @@ class InferenceEngine:
             # request-scoped temporary; params/state (args 0/1) are the
             # resident weights and must never be donated
             donate = () if jax.default_backend() == "cpu" else (2,)
+            jit_kw: Dict[str, Any] = {"donate_argnums": donate}
+            if self._mesh is not None:
+                jit_kw["in_shardings"] = (
+                    self._params_sh, self._state_sh,
+                    self._bucket_sharding(bucket),
+                )
             exe = (
-                jax.jit(self._fwd, donate_argnums=donate)
+                jax.jit(self._fwd, **jit_kw)
                 .lower(shape_of(params), shape_of(state), structs)
                 .compile()
             )
             self._cache[key] = exe
         return exe
+
+    def _bucket_sharding(self, bucket: int):
+        """Request rows shard over the layout's batch axis when the
+        bucket divides it; small buckets stay replicated (a bucket-1
+        request can't split)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dp = self.layout.batch_axis
+        ndp = self._mesh.shape.get(dp, 1)
+        spec = P(dp) if ndp > 1 and bucket % ndp == 0 else P()
+        return NamedSharding(self._mesh, spec)
 
     def warmup(self) -> "InferenceEngine":
         """Compile every bucket up front, so the first request of each
@@ -320,6 +365,13 @@ class InferenceEngine:
                     )
                     chunk = np.concatenate([chunk, pad])
                 dev[name] = jnp.asarray(chunk, self._input_dtype(name))
+            if self._mesh is not None:
+                # AOT executables take inputs exactly as compiled: the
+                # request batch must land pre-sharded on the mesh
+                bsh = self._bucket_sharding(bucket)
+                dev = {
+                    name: jax.device_put(a, bsh) for name, a in dev.items()
+                }
             exe = self._executable(bucket, weights)
             t0 = time.perf_counter()
             with _trace.span("serve.infer", cat="serve",

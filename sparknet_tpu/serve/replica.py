@@ -86,6 +86,12 @@ def add_engine_args(ap: argparse.ArgumentParser) -> None:
         help="attach read-only to a decoded-batch cache namespace "
              "(PR 8); /classify then accepts cache_key bodies",
     )
+    ap.add_argument(
+        "--layout", default=None, metavar="AXES",
+        help="multi-device replica layout, e.g. dp=2,tp=2: weights "
+             "shard per the training rule table (docs/PARALLELISM.md) "
+             "and the compile cache keys include the layout",
+    )
 
 
 def build_stack(args, *, watch_in_server: bool = True):
@@ -100,6 +106,11 @@ def build_stack(args, *, watch_in_server: bool = True):
     from .metrics import ServeMetrics
     from .server import InferenceServer
 
+    layout = None
+    if getattr(args, "layout", None):
+        from ..parallel import partition
+
+        layout = partition.parse_layout(args.layout, rules="tp")
     metrics = ServeMetrics(args.buckets)
     engine = InferenceEngine.from_files(
         args.model,
@@ -107,6 +118,7 @@ def build_stack(args, *, watch_in_server: bool = True):
         buckets=args.buckets,
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         metrics=metrics,
+        layout=layout,
     )
     cache_info = None
     if args.compile_cache:

@@ -423,16 +423,16 @@ class Solver:
 
         st = snapshot.load_state(path)
         saved_env = st.get("env") or {}
+        # the full saved env, for drift hooks that need sibling keys
+        # (the parallel solver reads the snapshot's per-leaf specs when
+        # wording its relayout warning)
+        self._restored_env = saved_env
         for key, saved in saved_env.items():
             cur = self.env_meta.get(key)
             if cur is not None and cur != saved and jax.process_index() == 0:
-                print(
-                    f"WARNING: resuming a run snapshotted with "
-                    f"{key}={saved!r} in an environment where "
-                    f"{key}={cur!r} — the shuffle/augmentation stream "
-                    f"will differ from the uninterrupted run",
-                    file=sys.stderr, flush=True,
-                )
+                msg = self._env_drift_message(key, saved, cur)
+                if msg:
+                    print(f"WARNING: {msg}", file=sys.stderr, flush=True)
         self.iter = int(st["it"])
         self.rng = jnp.asarray(st["rng"])
         self._loss_window.clear()  # a restarted Caffe starts empty
@@ -488,6 +488,17 @@ class Solver:
         else:
             for _ in range(n):
                 next(feed)
+
+    def _env_drift_message(self, key: str, saved, cur) -> str:
+        """One warning line for an env_meta key that differs between
+        the snapshot and this run; subclasses override per key (the
+        parallel solver turns layout drift into a relayout notice).
+        Return "" to suppress."""
+        return (
+            f"resuming a run snapshotted with {key}={saved!r} in an "
+            f"environment where {key}={cur!r} — the shuffle/"
+            f"augmentation stream will differ from the uninterrupted run"
+        )
 
     def _place_restored(self, params, state, opt_state):
         """Device placement for restored host trees; ParallelSolver

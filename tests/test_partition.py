@@ -1,0 +1,382 @@
+"""Unified sharding compile path (parallel/partition.py) on the
+8-device virtual CPU mesh.
+
+Pins the ISSUE 10 contract:
+- rule table semantics: first-match-wins ordering, explicit replicated
+  fallback for unmatched leaves, strict rejection of non-divisible
+  dims, scalar leaves never partitioned;
+- BITWISE weight equality between the legacy hand-built dp shardings
+  and the rule-table-built ones over 5 training steps (the refactor
+  changes zero numerics when the shardings agree);
+- a NEW dp×tp layout needs only a table entry — an arch trains under
+  it with zero parallel/ code changes and matches single-device math
+  to reduction-order accuracy;
+- per-leaf specs round-trip through snapshot save/restore, and a
+  resume under a different layout relayouts with one warning;
+- the serve fingerprint is layout-keyed so compile caches never alias.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sparknet_tpu.parallel import ParallelSolver, make_mesh, partition
+from sparknet_tpu.parallel.partition import (
+    Layout,
+    Rule,
+    layout_from_json,
+    layout_to_json,
+    match_spec,
+    parse_layout,
+    spec_from_str,
+    spec_to_str,
+    spec_tree,
+)
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.solver.trainer import Solver
+
+from .test_parallel import SHAPES, TINY_NET, SOLVER_TXT, batch, tiny_net, tiny_solver
+
+
+def feed_of(b):
+    def gen():
+        while True:
+            yield b
+    return gen()
+
+
+# ---------------------------------------------------------------------------
+# rule-table semantics
+# ---------------------------------------------------------------------------
+
+def test_first_match_wins_ordering():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices()[:8])
+    leaf = jnp.zeros((8, 8))
+    rules = (
+        Rule(r"ip1/weight", (None, "tp")),
+        Rule(r"weight", ("tp", None)),  # broader rule AFTER the specific
+    )
+    assert match_spec(rules, "ip1/weight", leaf, mesh) == P(None, "tp")
+    assert match_spec(rules, "ip2/weight", leaf, mesh) == P("tp")
+    # reversed order: the broad rule shadows the specific one
+    assert match_spec(rules[::-1], "ip1/weight", leaf, mesh) == P("tp")
+
+
+def test_unmatched_leaf_falls_back_replicated():
+    mesh = make_mesh({"dp": 8}, jax.devices()[:8])
+    rules = (Rule(r"weight$", ("dp",)),)
+    assert match_spec(rules, "ip1/bias", jnp.zeros((8,)), mesh) == P()
+    # scalars never partition, even when a rule matches
+    assert match_spec(rules, "scale/weight", jnp.zeros(()), mesh) == P()
+
+
+def test_rule_axes_absent_from_mesh_degrade_to_replicated():
+    """One ruleset serves every layout: axes the mesh lacks become
+    None, so 'tp' rules are harmless on a pure-dp mesh."""
+    mesh = make_mesh({"dp": 8}, jax.devices()[:8])
+    rules = (Rule(r"weight$", (None, "tp")),)
+    assert match_spec(rules, "ip1/weight", jnp.zeros((8, 8)), mesh) == P()
+
+
+def test_trailing_align_shards_last_dim():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices()[:8])
+    rules = (Rule(r"weight$", ("tp",), align="trailing"),)
+    conv = jnp.zeros((5, 5, 3, 32))
+    ip = jnp.zeros((64, 8))
+    assert match_spec(rules, "conv1/weight", conv, mesh) == P(
+        None, None, None, "tp"
+    )
+    assert match_spec(rules, "ip1/weight", ip, mesh) == P(None, "tp")
+
+
+def test_strict_rejects_nondivisible_dims():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices()[:8])
+    tree = {"ip": {"weight": jnp.zeros((8, 10))}}  # 10 % 4 != 0
+    rules = (Rule(r"weight$", (None, "tp")),)
+    with pytest.raises(ValueError, match="not\\s+divisible"):
+        spec_tree(tree, rules, mesh, validate="strict")
+    # validate=off accepts the same table
+    specs = spec_tree(tree, rules, mesh, validate="off")
+    assert specs["ip"]["weight"] == P(None, "tp")
+
+
+def test_rank_overflow_rejected():
+    mesh = make_mesh({"dp": 8}, jax.devices()[:8])
+    rules = (Rule(r"bias$", (None, "dp")),)
+    with pytest.raises(ValueError, match="rank"):
+        match_spec(rules, "ip/bias", jnp.zeros((8,)), mesh)
+
+
+def test_spec_string_round_trip():
+    for spec in (P(), P("dp"), P(None, "tp"), P(("dp", "tp"), None), P("tp", None)):
+        assert spec_from_str(spec_to_str(spec)) == spec
+
+
+def test_layout_json_round_trip():
+    lay = parse_layout("dp=2,tp=4", rules="bert", name="mine")
+    back = layout_from_json(layout_to_json(lay))
+    assert back.axes == lay.axes
+    assert back.rules == lay.rules
+    assert partition.layout_fingerprint(back) == partition.layout_fingerprint(lay)
+    # a different rule table is a different fingerprint
+    other = parse_layout("dp=2,tp=4", rules="tp")
+    assert partition.layout_fingerprint(other) != partition.layout_fingerprint(lay)
+
+
+# ---------------------------------------------------------------------------
+# the compiled path
+# ---------------------------------------------------------------------------
+
+def test_bitwise_legacy_dp_equals_unified_dp():
+    """5 training steps with hand-built dp shardings (the pre-refactor
+    make_dp_train_step spec construction, inlined here as the oracle)
+    vs the rule-table path — identical shardings must give identical
+    executables, pinned BITWISE on the trained weights."""
+    from sparknet_tpu.solver.trainer import make_train_step
+    from sparknet_tpu.solver.caffe_solver import init_opt_state
+
+    net_param = tiny_net()
+    sp = tiny_solver()
+    mesh = make_mesh()
+    from sparknet_tpu.nets.xlanet import XLANet
+
+    net = XLANet(net_param, "TRAIN", SHAPES)
+    params, state = net.init(jax.random.PRNGKey(3))
+    opt = init_opt_state(sp, params)
+    # host copies per arm: on CPU device_put can alias rather than
+    # copy, and both arms donate — a shared buffer would be deleted
+    # out from under the second arm
+    params, state, opt = (
+        jax.device_get(params), jax.device_get(state), jax.device_get(opt)
+    )
+    b = batch(0)
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("dp"))
+
+    # legacy: the old hand-rolled implicit-dp jit
+    legacy = jax.jit(
+        make_train_step(net, sp),
+        in_shardings=(repl, repl, repl, bsh, repl, repl),
+        out_shardings=(repl, repl, repl, repl),
+        donate_argnums=(0, 1, 2),
+    )
+    p1, s1, o1 = (
+        jax.device_put(params, repl), jax.device_put(state, repl),
+        jax.device_put(opt, repl),
+    )
+    for it in range(5):
+        p1, s1, o1, _ = legacy(
+            p1, s1, o1, jax.device_put(b, bsh),
+            jnp.asarray(it, jnp.int32), jax.random.PRNGKey(7),
+        )
+
+    # unified: the same shardings from the (empty) rule table
+    lay = Layout(axes=(("dp", 8),), rules=(), name="dp")
+    plan = partition.make_plan(lay, params, state, sp, mesh=mesh)
+    step = partition.make_sharded_train_step(net, sp, plan)
+    p2 = partition.place(params, plan.params_sh)
+    s2 = partition.place(state, plan.state_sh)
+    o2 = partition.place(opt, plan.opt_sh)
+    for it in range(5):
+        p2, s2, o2, _ = step(
+            p2, s2, o2, jax.device_put(b, plan.batch_train_sh),
+            jnp.asarray(it, jnp.int32), jax.random.PRNGKey(7),
+        )
+    for (ka, a), (kb, c) in zip(
+        partition.tree_paths(p1), partition.tree_paths(p2)
+    ):
+        assert ka == kb
+        assert (np.asarray(a) == np.asarray(c)).all(), ka
+
+
+def test_new_layout_is_a_table_entry():
+    """The acceptance pin: a dp×tp layout over the tiny net needs ONLY
+    a rule-table entry (no step builder, no parallel/ code) and
+    matches single-device training to reduction-order accuracy."""
+    sp = tiny_solver()
+    lay = parse_layout("dp=2,tp=2", rules="tp")
+    par = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7, layout=lay
+    )
+    rep = par.layout_report()
+    assert rep["path"] == "unified"
+    assert rep["sharded"] >= 2  # both IP weights (and biases) shard
+    single = Solver(sp, SHAPES, net_param=tiny_net(), seed=7)
+    b = batch(1)
+    par.step(feed_of(b), 5)
+    single.step(feed_of(b), 5)
+    for (ka, a), (kb, c) in zip(
+        partition.tree_paths(jax.device_get(par.params)),
+        partition.tree_paths(jax.device_get(single.params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-6, err_msg=ka
+        )
+    # and the params really are distributed per the table
+    w_sh = par.params["ip1"]["weight"].sharding
+    assert w_sh.spec == P(None, "tp"), w_sh
+
+
+def test_unified_eval_step_shares_the_path():
+    lay = parse_layout("dp=2,tp=2", rules="tp")
+    par = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7, layout=lay
+    )
+    single = Solver(tiny_solver(), SHAPES, net_param=tiny_net(), seed=7)
+    b = batch(2)
+    m_par = par.test(feed_of(b), test_iter=2)
+    m_single = single.test(feed_of(b), test_iter=2)
+    for k in m_single:
+        np.testing.assert_allclose(m_par[k], m_single[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_local_mode_rejects_model_parallel_layout():
+    with pytest.raises(ValueError, match="dp-only"):
+        ParallelSolver(
+            tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+            layout=parse_layout("dp=2,tp=2", rules="tp"), mode="local",
+        )
+
+
+def test_local_mode_over_dp_only_layout():
+    """τ-local SGD rides a dp-shaped layout unchanged: same machinery,
+    mesh built from the table."""
+    s = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+        layout=parse_layout("dp=8"), mode="local", tau=2,
+    )
+    m = s.step(feed_of(batch(3)), 4)
+    assert np.isfinite(float(m["loss"]))
+    assert s.iter == 4
+    assert s.layout_report()["path"] == "legacy-local"
+
+
+# ---------------------------------------------------------------------------
+# snapshot round-trip + relayout-on-resume
+# ---------------------------------------------------------------------------
+
+def test_specs_round_trip_through_snapshot(tmp_path, capsys):
+    lay = parse_layout("dp=8", rules="replicated", name="dp8")
+    a = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7, layout=lay
+    )
+    a.step(feed_of(batch(4)), 3)
+    snap = str(tmp_path / "iter3.solverstate.npz")
+    a.save(snap)
+
+    # same layout back: specs match, NO relayout warning
+    b1 = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7, layout=lay
+    )
+    b1.restore(snap)
+    from sparknet_tpu.solver import snapshot as snap_mod
+
+    st = snap_mod.load_state(snap)
+    env = st["env"]
+    saved_specs = json.loads(str(env["param_specs"]))
+    assert saved_specs == b1._plan.specs
+    assert json.loads(str(env["layout"]))["name"] == "dp8"
+
+    # different layout: leaves land per the RUN's table + one warning
+    import io, contextlib, sys as _sys
+
+    lay2 = parse_layout("dp=2,tp=2", rules="tp")
+    b2 = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7, layout=lay2
+    )
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        b2.restore(snap)
+    assert "relayout on resume" in err.getvalue()
+    assert b2.params["ip1"]["weight"].sharding.spec == P(None, "tp")
+    # weights bitwise-equal to the snapshot (placement never mutates)
+    for (k, x), (k2, y) in zip(
+        partition.tree_paths(jax.device_get(a.params)),
+        partition.tree_paths(jax.device_get(b2.params)),
+    ):
+        assert (np.asarray(x) == np.asarray(y)).all(), k
+    # and training continues through the new layout's compiled path
+    m = b2.step(feed_of(batch(4)), 1)
+    assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# serve-side: fingerprint + guards
+# ---------------------------------------------------------------------------
+
+def test_net_fingerprint_is_layout_keyed():
+    from sparknet_tpu.nets.xlanet import XLANet
+    from sparknet_tpu.serve.compile_cache import net_fingerprint
+
+    net = XLANet(tiny_net(), "TEST", SHAPES)
+    params, state = net.init(jax.random.PRNGKey(0))
+    base = net_fingerprint(net, params, state, jnp.float32)
+    lay = parse_layout("dp=2,tp=2", rules="tp")
+    keyed = net_fingerprint(net, params, state, jnp.float32, layout=lay)
+    other = net_fingerprint(
+        net, params, state, jnp.float32,
+        layout=parse_layout("dp=4", rules="replicated"),
+    )
+    assert len({base, keyed, other}) == 3
+
+
+def test_engine_serves_through_layout_shardings():
+    """A multi-device replica compiles through the same sharding trees
+    training uses and answers identically to a single-device engine."""
+    from sparknet_tpu.nets.xlanet import XLANet
+    from sparknet_tpu.serve.engine import InferenceEngine
+
+    net = XLANet(tiny_net(), "TEST", SHAPES)
+    params, state = net.init(jax.random.PRNGKey(0))
+    plain = InferenceEngine(net, params, state, buckets=(4, 8),
+                            output="ip2")
+    lay = parse_layout("dp=2,tp=2", rules="tp")
+    sharded = InferenceEngine(net, params, state, buckets=(4, 8),
+                              output="ip2", layout=lay)
+    assert plain.fingerprint != sharded.fingerprint
+    rows = np.asarray(
+        np.random.default_rng(0).normal(size=(6, 8)), np.float32
+    )
+    out_plain = plain.infer({"data": rows})
+    out_sharded = sharded.infer({"data": rows})
+    np.testing.assert_allclose(out_sharded, out_plain, rtol=1e-5,
+                               atol=1e-6)
+    assert sharded.params["ip1"]["weight"].sharding.spec == P(None, "tp")
+
+
+def test_fence_once_respects_timeline_fence():
+    """The compiled-step fence guard: with a fencing timeline active,
+    fence_once must NOT add a second block_until_ready to the timed
+    region (it returns the tree untouched)."""
+    from sparknet_tpu.telemetry import timeline as _ttl
+
+    x = jnp.arange(4.0)
+    tl = _ttl.Timeline(fence=True)
+    _ttl.set_current(tl)
+    try:
+        got = partition.fence_once(x)
+        assert got is x  # untouched — no second fence
+    finally:
+        _ttl.set_current(None)
+    got = partition.fence_once(x)  # no timeline: this IS the fence
+    assert got is not None
+
+
+def test_ensure_virtual_devices_is_idempotent_and_loud():
+    import warnings as _w
+
+    # backend is initialized in the test process: asking for more
+    # devices than exist must WARN, not silently proceed
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        ok = partition.ensure_virtual_devices(len(jax.devices()) + 1)
+    assert not ok
+    assert any("already initialized" in str(r.message) for r in rec)
+    # asking for what we have succeeds silently
+    assert partition.ensure_virtual_devices(len(jax.devices()))
