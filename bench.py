@@ -672,10 +672,16 @@ def bench_serving_tier(platform: str) -> dict:
        non-saturating mixed load; the continuous admitter dispatches
        when the arrival-rate EWMA says a bigger bucket is unreachable
        — p99 (and p50) should drop at the same offered rate.
+    1b. **Request-trace overhead**: the same closed-loop load over
+       HTTP with ``SPARKNET_REQTRACE`` on vs off — the exact-p50 cost
+       of per-request tracing, gated ≤2% by ``bench_diff``
+       (``reqtrace_overhead_pct``).
     2. **Chaos e2e** (subprocess): a 2-replica router tier takes a
        loadgen burst while one replica is SIGKILLed and a rolling
        hot-swap lands; the bar is ZERO failed requests and both
-       generations observed in responses.
+       generations observed in responses — and the loadgen record
+       names the trace ids of its failed / >p99 requests, so slow
+       requests are look-up-able in the tier's ``/traces`` export.
     3. **Warm-restart warmup**: the respawned replica boots against
        the compile cache its predecessor populated — warmup_s cold vs
        warm (acceptance: >= 30% cut).
@@ -721,6 +727,70 @@ def bench_serving_tier(platform: str) -> dict:
         }
     p99_fill = arms["fill"]["p99_ms"] or 1e-9
     p99_cont = arms["continuous"]["p99_ms"] or 1e-9
+
+    # ---- arm 1b: request-trace overhead (ISSUE 11 satellite) — the
+    # same closed-loop load over the WIRE with tracing on vs off; the
+    # bar is a ≤2% p50 cost (bench_diff gates reqtrace_overhead_pct).
+    # Exact percentiles (p50_exact_ms) — the histogram's ~1.47x bins
+    # cannot resolve a 2% delta.
+    from sparknet_tpu.serve.server import InferenceServer
+    from sparknet_tpu.telemetry import reqtrace
+
+    metrics = ServeMetrics(buckets)
+    engine.metrics = metrics
+    rt_batcher = MicroBatcher(
+        engine, metrics=metrics, mode="continuous", max_latency_us=20_000
+    )
+    rt_server = InferenceServer(
+        engine, batcher=rt_batcher, metrics=metrics, port=0
+    ).start()
+    rt_rounds = []
+    try:
+        # warm pass with tracing ON, outside the measured rounds: the
+        # first traced burst pays one-time costs (lazy imports, first
+        # registry families) — the A/B measures steady state, same
+        # rationale as engine.warmup before the timed window
+        reqtrace.enable()
+        run_http_loadgen(
+            rt_server.host, rt_server.port, (32, 32, 3),
+            n_requests=max(20, n_req // 8), sizes=(1,), concurrency=1,
+        )
+        # serial fixed-size requests, interleaved off/on rounds, median
+        # of the per-round deltas: under concurrency the p50 is set by
+        # batching composition and queueing (~±10% run-to-run on this
+        # box — an order of magnitude above the ≤2% bar); one-row
+        # serial requests make the p50 a pure per-request service time,
+        # where the tracing cost actually lives, and pairing the arms
+        # within a round cancels slow drift
+        for _ in range(3):
+            pair = {}
+            for arm, on in (("off", False), ("on", True)):
+                (reqtrace.enable if on else reqtrace.disable)()
+                rec = run_http_loadgen(
+                    rt_server.host, rt_server.port, (32, 32, 3),
+                    n_requests=max(40, n_req // 3), sizes=(1,),
+                    concurrency=1,
+                )
+                pair[arm] = {
+                    "p50_exact_ms": rec["p50_exact_ms"],
+                    "p99_exact_ms": rec["p99_exact_ms"],
+                    "failed_requests": rec["failed_requests"],
+                }
+            on_ms = pair["on"]["p50_exact_ms"]
+            off_ms = pair["off"]["p50_exact_ms"]
+            pair["overhead_pct"] = (
+                round(100.0 * (on_ms - off_ms) / off_ms, 2)
+                if on_ms and off_ms else None
+            )
+            rt_rounds.append(pair)
+    finally:
+        reqtrace.configure_from_env()
+        rt_server.stop()
+    pcts = sorted(
+        p["overhead_pct"] for p in rt_rounds
+        if p["overhead_pct"] is not None
+    )
+    reqtrace_overhead_pct = pcts[len(pcts) // 2] if pcts else None
 
     # ---- arms 2+3: the replicated tier under kill + hot-swap chaos
     tmp = tempfile.mkdtemp(prefix="bench_serving_tier_")
@@ -828,6 +898,10 @@ def bench_serving_tier(platform: str) -> dict:
             "p99_improvement": round(p99_fill / p99_cont, 3),
             "p50_ms": arms["continuous"]["p50_ms"],
             "p99_ms": arms["continuous"]["p99_ms"],
+            # request-tracing cost at equal load: median per-round %
+            # p50 regression, tracing-on vs off (bench_diff gates ≤2%)
+            "reqtrace_overhead_pct": reqtrace_overhead_pct,
+            "reqtrace": {"rounds": rt_rounds},
             "tier": {
                 "replicas": 2,
                 "failed_requests": lg.get("failed_requests"),

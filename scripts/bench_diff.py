@@ -164,6 +164,15 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
     if b is not None:
         add("failed_requests", a, b, "", bool(b),
             "ZERO is the bar" if b else "ok")
+    # request-trace overhead (serving_tier records): % p50 cost of
+    # tracing-on vs tracing-off at equal load — an ABSOLUTE bar like
+    # failed_requests, not a ratio against the old record
+    b = find_key(new, "reqtrace_overhead_pct")
+    if b is not None:
+        a = find_key(old, "reqtrace_overhead_pct")
+        over = b > args.reqtrace_pct
+        add("reqtrace_overhead_pct", a, b, "", over,
+            f"≤{args.reqtrace_pct:g}% is the bar" if over else "ok")
     # served-generation coverage (hot-swap observability): count of
     # distinct generations answered during the run — informational
     gens_old = (old.get("tier") or {}).get("served_generations")
@@ -209,6 +218,9 @@ def main(argv=None) -> int:
     ap.add_argument("--wire-pct", type=float, default=25.0,
                     help="max tolerated wire-bytes growth, percent "
                          "(default 25)")
+    ap.add_argument("--reqtrace-pct", type=float, default=2.0,
+                    help="max tolerated request-tracing p50 overhead, "
+                         "percent of the tracing-off p50 (default 2)")
     ap.add_argument("--informational", action="store_true",
                     help="print the table but always exit 0 (the "
                          "check.sh mode)")

@@ -330,14 +330,16 @@ else
   exit 1
 fi
 
-# ---- serving-tier smoke (ISSUE 9): 2 subprocess engine replicas behind
-# the router take a closed-loop HTTP burst while one replica is
+# ---- serving-tier smoke (ISSUE 9 + 11): 2 subprocess engine replicas
+# behind the router take a closed-loop HTTP burst while one replica is
 # SIGKILLed and a rolling hot-swap to a new verified solverstate lands —
-# zero failed requests, both generations served, and the respawned
-# replica must boot off the persistent compile cache (no new entries
-# written during its warmup).
+# zero failed requests, both generations served, the respawned replica
+# must boot off the persistent compile cache (no new entries written
+# during its warmup), and the router's /traces export must hold a
+# stitched request waterfall with >=5 spans attributing >=90% of wall
+# latency (telemetry/reqtrace.py).
 if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py; then
-  echo "check.sh: serving smoke OK (replica kill + hot-swap, 0 failed, cache-hit respawn)"
+  echo "check.sh: serving smoke OK (replica kill + hot-swap, 0 failed, cache-hit respawn, stitched waterfall)"
 else
   echo "check.sh: serving SMOKE FAILED"
   exit 1

@@ -167,11 +167,56 @@ def main() -> int:
         assert warm is not None and cold is not None and warm < cold, (
             f"warm restart not faster: cold={cold}s warm={warm}s"
         )
+
+        # ---- stitched request waterfalls (ISSUE 11): the router's
+        # /traces export must hold at least one cross-process waterfall
+        # with >=5 spans covering the whole hop taxonomy, attributing
+        # >=90% of the measured wall latency
+        import urllib.request
+
+        trace_doc = json.loads(urllib.request.urlopen(
+            f"http://{doc['host']}:{doc['port']}/traces"
+        ).read())
+        by_trace = {}
+        for ev in trace_doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            by_trace.setdefault(ev["args"]["trace"], []).append(ev)
+        assert by_trace, "router /traces export is empty"
+        best_tid, best = max(by_trace.items(), key=lambda kv: len(kv[1]))
+        names = {ev["name"] for ev in best}
+        assert len(best) >= 5 and {
+            "server.request", "batcher.wait", "engine.compute",
+            "serve.serialize",
+        } <= names and names & {"router.dispatch", "router.retry"}, (
+            f"no stitched waterfall with >=5 spans across the hop "
+            f"taxonomy: trace {best_tid} has {sorted(names)}"
+        )
+        ivs = sorted((ev["ts"], ev["ts"] + ev.get("dur", 0)) for ev in best)
+        union, (ca, cb) = 0.0, ivs[0]
+        for a, b in ivs[1:]:
+            if a > cb:
+                union += cb - ca
+                ca, cb = a, b
+            else:
+                cb = max(cb, b)
+        union += cb - ca
+        wall = max(b for _, b in ivs) - min(a for a, _ in ivs)
+        assert union >= 0.9 * wall, (
+            f"waterfall attributes {union / wall:.0%} of wall latency"
+        )
+        retried = sum(
+            1 for evs in by_trace.values()
+            if any(e["name"] == "router.retry" for e in evs)
+        )
         print(
             "serving smoke: OK — 0 failed requests across kill + "
             f"hot-swap (gens {gens_seen}), respawn "
             f"warmup {warm}s vs cold {cold}s on "
-            f"{cc.get('entries')} cached entries"
+            f"{cc.get('entries')} cached entries; "
+            f"{len(by_trace)} stitched waterfalls "
+            f"(best {len(best)} spans, {union / wall:.0%} attributed, "
+            f"{retried} with retry hops)"
         )
         return 0
     finally:

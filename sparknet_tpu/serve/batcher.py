@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry import reqtrace as _reqtrace
 from ..telemetry import trace as _trace
 
 # EWMA smoothing for the continuous admitter's two estimators
@@ -76,9 +77,10 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("rows", "n", "future", "t_enq", "deadline")
+    __slots__ = ("rows", "n", "future", "t_enq", "deadline", "ctx")
 
-    def __init__(self, rows: np.ndarray, deadline_s: Optional[float] = None):
+    def __init__(self, rows: np.ndarray, deadline_s: Optional[float] = None,
+                 ctx=None):
         self.rows = rows
         self.n = len(rows)
         self.future: Future = Future()
@@ -86,6 +88,10 @@ class _Pending:
         self.deadline = (
             None if deadline_s is None else self.t_enq + deadline_s
         )
+        # request-trace context (telemetry/reqtrace.py): when set, the
+        # queue wait, deadline shed and engine compute become spans on
+        # the request's cross-process waterfall
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -146,17 +152,21 @@ class MicroBatcher:
         block: bool = False,
         timeout: Optional[float] = None,
         deadline_s: Optional[float] = None,
+        ctx=None,
     ) -> Future:
         """Enqueue one request of N rows; resolves to the engine output
         for exactly those rows. ``block=False`` (the server's mode)
         raises :class:`Backpressure` when the queue is full; closed-loop
         clients pass ``block=True`` to wait for room instead.
-        ``deadline_s`` overrides the batcher-level default deadline."""
+        ``deadline_s`` overrides the batcher-level default deadline.
+        ``ctx``: an optional request-trace context — its queue wait and
+        engine compute are recorded as waterfall spans."""
         if not self._open:
             raise RuntimeError("MicroBatcher is drained/closed")
         item = _Pending(
             np.asarray(rows),
             self.deadline_s if deadline_s is None else deadline_s,
+            ctx,
         )
         if item.n == 0:
             raise ValueError("submit: empty request")
@@ -319,8 +329,18 @@ class MicroBatcher:
                 it.future.set_exception(DeadlineExceeded(
                     f"request expired after {now - it.t_enq:.3f}s in queue"
                 ))
+                if it.ctx is not None:
+                    _reqtrace.record_interval(
+                        it.ctx, "batcher.shed", it.t_enq,
+                        reason="deadline", rows=it.n,
+                    )
             elif not it.future.set_running_or_notify_cancel():
                 cancelled += 1
+                if it.ctx is not None:
+                    _reqtrace.record_interval(
+                        it.ctx, "batcher.shed", it.t_enq,
+                        reason="cancelled", rows=it.n,
+                    )
             else:
                 live.append(it)
         if self.metrics is not None:
@@ -331,16 +351,32 @@ class MicroBatcher:
         if not live:
             return
         batch = live
+        # admission wait: enqueue -> dispatch instant, per request (the
+        # bucket wait is inside it — the continuous admitter's co-rider
+        # window is queue time by construction)
+        for it in batch:
+            if it.ctx is not None:
+                _reqtrace.record_interval(
+                    it.ctx, "batcher.wait", it.t_enq,
+                    rows=it.n, mode=self.mode,
+                )
         t0 = time.perf_counter()
         try:
             with _trace.span("serve.flush", cat="serve",
                              requests=len(batch), rows=total):
-                if len(batch) == 1:
-                    out = self.engine.infer(batch[0].rows)
+                rows_cat = (
+                    batch[0].rows if len(batch) == 1
+                    else np.concatenate([it.rows for it in batch])
+                )
+                # tagged path when the engine offers it: the weights
+                # generation the WHOLE batch computed with (hot-swap
+                # observability on every compute span)
+                tagged = getattr(self.engine, "infer_tagged", None)
+                if tagged is not None:
+                    out, gen = tagged(rows_cat)
                 else:
-                    out = self.engine.infer(
-                        np.concatenate([it.rows for it in batch])
-                    )
+                    out = self.engine.infer(rows_cat)
+                    gen = getattr(self.engine, "generation", 0)
         except Exception as e:
             if self.metrics is not None:
                 self.metrics.record_error(len(batch))
@@ -348,19 +384,38 @@ class MicroBatcher:
                 if not it.future.cancelled():
                     it.future.set_exception(e)
             return
+        live_rows = sum(it.n for it in batch)
         if self.mode == "continuous":
-            live_rows = sum(it.n for it in batch)
             self._observe_service(
                 self._bucket_for(live_rows), time.perf_counter() - t0
             )
         now = time.perf_counter()
+        bucket = self._bucket_for(live_rows)
         ofs = 0
         for it in batch:
+            if it.ctx is not None:
+                # one compute span per co-riding request: same batch
+                # interval, tagged with the bucket + weights generation.
+                # Recorded BEFORE the future resolves — the handler
+                # thread gathers the span batch the moment result()
+                # returns, and a span landing after that gather would
+                # miss the response header.
+                _reqtrace.record_interval(
+                    it.ctx, "engine.compute", t0, now,
+                    bucket=bucket, rows=it.n, gen=gen,
+                )
             if not it.future.cancelled():
                 it.future.set_result(out[ofs : ofs + it.n])
             ofs += it.n
             if self.metrics is not None:
-                self.metrics.record_request(now - it.t_enq, rows=it.n)
+                lat = now - it.t_enq
+                self.metrics.record_request(
+                    lat, rows=it.n,
+                    exemplar=(
+                        (it.ctx.trace_id, lat)
+                        if it.ctx is not None and it.ctx.sampled else None
+                    ),
+                )
 
     # ------------------------------------------------------------------
     def drain(self, timeout: Optional[float] = 30.0) -> None:

@@ -375,7 +375,8 @@ class InferenceEngine:
             exe = self._executable(bucket, weights)
             t0 = time.perf_counter()
             with _trace.span("serve.infer", cat="serve",
-                             bucket=bucket, rows=take):
+                             bucket=bucket, rows=take,
+                             padded=bucket - take, gen=gen):
                 # np.asarray is the device fence
                 out = np.asarray(exe(params, state, dev))
             if self.metrics is not None:

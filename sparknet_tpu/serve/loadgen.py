@@ -134,7 +134,17 @@ def run_http_loadgen(
     the zero-failed-requests bar the chaos scenarios are held to.
     Latency is measured per request *including* retries (a killed
     replica costs latency, never answers) and the record carries every
-    distinct weights generation observed (``served_generations``)."""
+    distinct weights generation observed (``served_generations``).
+
+    Every request mints its own trace context client-side
+    (``telemetry/reqtrace.py``) and sends it in the
+    ``X-Sparknet-Trace`` header, so the tier's stitched waterfalls are
+    correlatable with this record: the trace ids of every **failed**
+    and every **slower-than-p99** request ride the result dict
+    (``failed_request_traces`` / ``slow_request_traces``) — a
+    ``BENCH_MODEL=serving_tier`` record can name the exact slow
+    requests it measured."""
+    from ..telemetry import reqtrace
     from ..telemetry.registry import LatencyHistogram
     from .server import Client
 
@@ -142,6 +152,8 @@ def run_http_loadgen(
     counter = {"next": 0}
     lock = threading.Lock()
     errors = []
+    failed_traces = []
+    samples = []  # (request index, trace id, latency seconds)
     generations = set()
 
     def worker(wid: int):
@@ -157,9 +169,15 @@ def run_http_loadgen(
             rows = rng.normal(size=(n,) + tuple(input_shape)).astype(
                 np.float32
             )
+            ctx = reqtrace.mint()  # None while tracing is disabled
+            tid = ctx.trace_id if ctx is not None else None
             t0 = time.perf_counter()
             try:
-                status, resp = client.classify(rows)
+                status, resp = client.classify(
+                    rows,
+                    trace=reqtrace.to_header(ctx) if ctx is not None
+                    else None,
+                )
                 if status != 200:
                     raise RuntimeError(f"HTTP {status}: {resp.get('error')}")
                 if len(resp["indices"]) != n:
@@ -169,10 +187,13 @@ def run_http_loadgen(
             except Exception as e:
                 with lock:
                     errors.append(f"req {i}: {type(e).__name__}: {e}")
+                    if tid is not None:
+                        failed_traces.append({"req": i, "trace": tid})
                 continue
             dt = time.perf_counter() - t0
             with lock:
                 lat.observe(dt)
+                samples.append((i, tid, dt))
                 if "gen" in resp:
                     generations.add(int(resp["gen"]))
 
@@ -188,6 +209,18 @@ def run_http_loadgen(
     dt = max(time.perf_counter() - t0, 1e-9)
     snap = lat.snapshot()
     total_rows = sum(int(sizes[i % len(sizes)]) for i in range(n_requests))
+    # exact (not histogram-bin-resolution) percentiles from the raw
+    # latency list: the reqtrace-overhead A/B in bench.py compares
+    # p50s at equal load, where the ~1.47x log-bin ladder is far too
+    # coarse to resolve a ≤2% bar
+    lats = sorted(s[2] for s in samples)
+    p50_exact = lats[int(0.50 * (len(lats) - 1))] if lats else None
+    p99_exact = lats[int(0.99 * (len(lats) - 1))] if lats else None
+    slow_traces = [
+        {"req": i, "trace": tid, "ms": round(s_dt * 1000, 3)}
+        for i, tid, s_dt in sorted(samples, key=lambda s: -s[2])
+        if p99_exact is not None and s_dt > p99_exact and tid is not None
+    ][:20]
     return {
         "metric": "serve_http_requests_per_sec",
         "value": round((n_requests - len(errors)) / dt, 2),
@@ -201,6 +234,16 @@ def run_http_loadgen(
         "p50_ms": snap["p50_ms"],
         "p95_ms": snap["p95_ms"],
         "p99_ms": snap["p99_ms"],
+        "p50_exact_ms": (
+            round(p50_exact * 1000, 3) if p50_exact is not None else None
+        ),
+        "p99_exact_ms": (
+            round(p99_exact * 1000, 3) if p99_exact is not None else None
+        ),
+        # the exact requests this record measured as failed or slow —
+        # look them up in the tier's /traces waterfalls by trace id
+        "failed_request_traces": failed_traces[:20],
+        "slow_request_traces": slow_traces,
         "served_generations": sorted(generations),
     }
 

@@ -91,12 +91,17 @@ class ServeMetrics:
             e["padded_rows"] += padded_rows
             e["device"].observe(device_s)
 
-    def record_request(self, latency_s: float, rows: int = 1) -> None:
+    def record_request(
+        self, latency_s: float, rows: int = 1, exemplar=None
+    ) -> None:
+        """``exemplar``: an optional ``(trace_id, seconds)`` pair from a
+        sampled request trace — becomes an OpenMetrics exemplar on the
+        latency histogram (telemetry/reqtrace.py)."""
         with self._lock:
             self.requests += 1
             self._window_requests += 1
             self.rows += rows
-            self.request_latency.observe(latency_s)
+            self.request_latency.observe(latency_s, exemplar=exemplar)
 
     def record_error(self, n: int = 1) -> None:
         with self._lock:

@@ -22,7 +22,10 @@ single-process serving stack (engine + batcher + HTTP server) that
 
 Kept deliberately free of router knowledge: a replica is just a
 server; the tier semantics (dispatch, retry, eject, roll) live in one
-place, ``serve/router.py``.
+place, ``serve/router.py``.  Request tracing follows the same rule:
+the replica records its hop spans (server/batcher/engine/serialize,
+``telemetry/reqtrace.py``) and returns them inline in the
+``X-Sparknet-Spans`` response header — stitching is the router's job.
 """
 
 from __future__ import annotations
@@ -179,9 +182,14 @@ def write_portfile(path: str, server, engine, cache_info) -> None:
 
 
 def main(argv=None) -> int:
+    from ..telemetry import reqtrace
     from ..tools._common import honor_platform_env
 
     honor_platform_env()
+    # request tracing rides the inherited env (the router's operator
+    # sets SPARKNET_REQTRACE once for the whole tier); re-resolve it
+    # explicitly so a respawn under a scrubbed env behaves the same
+    reqtrace.configure_from_env()
     ap = argparse.ArgumentParser(
         prog="sparknet-serve-replica",
         description="one engine replica of the serving tier",

@@ -28,10 +28,20 @@ to restart and trivially correct to reason about.
   generation before the next starts — capacity dips by one replica,
   never to zero, and a bad snapshot stops the roll at replica 0.
 
+- **Every request is a stitched trace** (``telemetry/reqtrace.py``):
+  the router mints (or adopts) the ``X-Sparknet-Trace`` context, spans
+  every dispatch attempt — each peer-retry hop as its own span with
+  the failure reason — merges the replica's span batch from the
+  ``X-Sparknet-Spans`` response header, and closes the cross-process
+  waterfall.  ``GET /traces`` exports the completed ring as
+  Perfetto-loadable Chrome trace JSON; ``/dash`` renders the slowest
+  requests as per-hop waterfall bars.
+
 The router speaks the same HTTP surface as a single replica
 (``/classify``, ``/healthz``, ``/metrics``, ``/metrics.json``,
-``/dash``, ``/reload``), so clients — including ``serve.Client`` and
-the load generator — cannot tell one process from a tier.
+``/dash``, ``/reload``, ``/traces``), so clients — including
+``serve.Client`` and the load generator — cannot tell one process
+from a tier.
 """
 
 from __future__ import annotations
@@ -202,7 +212,24 @@ class Router:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply(200, outer.healthz())
+                    from ..telemetry import anomaly as _anomaly
+
+                    # scrape-driven SLO burn: the router's end-to-end
+                    # request p99 (retries included) vs the budget
+                    _anomaly.observe_slo(outer.metrics.request_latency)
+                    doc = outer.healthz()
+                    doc["anomalies"] = _anomaly.active()
+                    self._reply(200, doc)
+                elif self.path == "/traces":
+                    from ..telemetry import reqtrace as _reqtrace
+
+                    # the stitched cross-process waterfalls as Chrome
+                    # trace JSON — the serving smoke's assertion target
+                    self._send(
+                        200,
+                        json.dumps(_reqtrace.export_chrome()).encode(),
+                        "application/json",
+                    )
                 elif self.path == "/metrics":
                     from ..telemetry.exporter import render_prometheus
 
@@ -216,12 +243,14 @@ class Router:
                     from ..telemetry import REGISTRY as _REG
                     from ..telemetry import anomaly as _anomaly
                     from ..telemetry import dash as _dash
+                    from ..telemetry import reqtrace as _reqtrace
 
                     page = _dash.render_html(
                         _REG.snapshot(),
                         anomalies=_anomaly.active(),
                         model_name=outer.model_name,
                         router=outer.snapshot(),
+                        reqtrace=_reqtrace.slowest(),
                     )
                     self._send(
                         200, page.encode(), "text/html; charset=utf-8"
@@ -233,7 +262,10 @@ class Router:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 if self.path == "/classify":
-                    code, payload, headers = outer.dispatch(body)
+                    code, payload, headers = outer.dispatch(
+                        body,
+                        trace_header=self.headers.get("X-Sparknet-Trace"),
+                    )
                     self._send(
                         code, payload, "application/json", headers
                     )
@@ -257,16 +289,22 @@ class Router:
     def _replica_request(
         self, rep: Replica, method: str, path: str,
         body: Optional[bytes] = None, timeout: Optional[float] = None,
-    ) -> Tuple[int, bytes]:
+        headers: Optional[dict] = None,
+    ):
+        """Returns ``(status, payload, response_headers)`` — the
+        response headers carry the replica's inline span batch
+        (``X-Sparknet-Spans``) for the stitch."""
         conn = http.client.HTTPConnection(
             rep.host, rep.port,
             timeout=timeout if timeout is not None else self.forward_timeout_s,
         )
         try:
-            headers = {"Content-Type": "application/json"} if body else {}
-            conn.request(method, path, body=body, headers=headers)
+            hdrs = {"Content-Type": "application/json"} if body else {}
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
-            return resp.status, resp.read()
+            return resp.status, resp.read(), resp.headers
         finally:
             conn.close()
 
@@ -311,14 +349,33 @@ class Router:
                 rep.healthy = False
                 self.metrics.inc("ejects")
 
-    def dispatch(self, body: bytes) -> Tuple[int, bytes, list]:
+    def dispatch(
+        self, body: bytes, trace_header: Optional[str] = None
+    ) -> Tuple[int, bytes, list]:
         """Forward one /classify body; retries on peers until a replica
         answers (anything but a connection failure / 5xx counts as an
-        answer — 400s are the client's problem, not the tier's)."""
+        answer — 400s are the client's problem, not the tier's).
+
+        The router is the tier's **stitching point**
+        (telemetry/reqtrace.py): it adopts the client's trace context
+        (``trace_header``) or mints one, records one span per dispatch
+        attempt (``router.dispatch``; retries as ``router.retry`` with
+        the prior failure's reason), merges the replica's inline span
+        batch from the response header, and closes the trace — the
+        full cross-process waterfall lands on the completed ring that
+        ``/traces`` exports and ``/dash`` renders.  Each mid-request
+        re-dispatch also leaves a machine-readable ``retry:`` JSON
+        line and a ``router_events{event="retry_hop"}`` increment."""
+        from ..telemetry import reqtrace
+
         self.metrics.inc("requests")
         t0 = time.perf_counter()
+        rctx = reqtrace.parse(trace_header) or reqtrace.mint()
         tried: set = set()
         last_err: Optional[str] = None
+        # (replica index, reason) of the newest failed attempt — set
+        # means the next forward is a retry hop
+        last_fail: Optional[Tuple[int, str]] = None
         # one full pass over the tier, plus one grace re-pass after a
         # short wait — a respawning replica (or a rolling swap) is a
         # latency blip, not an outage
@@ -333,29 +390,82 @@ class Router:
                     time.sleep(self.health_interval_s)
                     continue
                 break
+            if last_fail is not None:
+                # satellite: the mid-request peer retry as a structured
+                # record AT THE MOMENT of re-dispatch, not only as an
+                # aggregate counter
+                REGISTRY.counter("router_events", event="retry_hop").inc()
+                print("retry: " + json.dumps({
+                    "trace": rctx.trace_id if rctx is not None else None,
+                    "from": last_fail[0],
+                    "to": rep.index,
+                    "reason": last_fail[1],
+                }), flush=True)
+            hop = reqtrace.hop(
+                rctx,
+                "router.retry" if last_fail is not None else
+                "router.dispatch",
+            )
+            fwd_headers = (
+                {reqtrace.HEADER: reqtrace.to_header(hop.ctx)}
+                if hop.ctx is not None else None
+            )
+            hop_args = {"replica": rep.index}
+            if last_fail is not None:
+                hop_args["retry_of"] = last_fail[0]
+                hop_args["reason"] = last_fail[1]
             try:
-                status, payload = self._replica_request(
-                    rep, "POST", "/classify", body
+                status, payload, resp_headers = self._replica_request(
+                    rep, "POST", "/classify", body, headers=fwd_headers
                 )
             except (OSError, http.client.HTTPException) as e:
                 self._done(rep)
                 self._note_fail(rep)
                 tried.add(rep.index)
-                last_err = f"replica {rep.index}: {type(e).__name__}: {e}"
+                reason = f"{type(e).__name__}: {e}"
+                last_err = f"replica {rep.index}: {reason}"
+                last_fail = (rep.index, reason)
+                hop.finish(outcome="error", error=reason, **hop_args)
                 self.metrics.inc("retries")
                 continue
+            if rctx is not None:
+                # stitch: the replica's span batch rides the response
+                # header (even on a 5xx — a deadline shed's spans show
+                # the failed hop's internals)
+                reqtrace.adopt(rctx.trace_id, reqtrace.parse_spans_header(
+                    resp_headers.get(reqtrace.SPANS_HEADER)
+                ))
             if status >= 500 or status == 503:
                 # dying or overloaded replica: the request is
                 # idempotent — retry it on a peer
                 self._done(rep)
                 tried.add(rep.index)
-                last_err = f"replica {rep.index}: HTTP {status}"
+                reason = f"HTTP {status}"
+                last_err = f"replica {rep.index}: {reason}"
+                last_fail = (rep.index, reason)
+                hop.finish(outcome="error", error=reason, **hop_args)
                 self.metrics.inc("retries")
                 continue
-            self._done(rep, time.perf_counter() - t0)
-            self.metrics.request_latency.observe(time.perf_counter() - t0)
-            return status, payload, [("X-Sparknet-Replica", str(rep.index))]
+            hop.finish(outcome="ok", status=status, **hop_args)
+            dt = time.perf_counter() - t0
+            self._done(rep, dt)
+            self.metrics.request_latency.observe(
+                dt,
+                exemplar=(
+                    (rctx.trace_id, dt)
+                    if rctx is not None and rctx.sampled else None
+                ),
+            )
+            hdrs = [("X-Sparknet-Replica", str(rep.index))]
+            if rctx is not None:
+                reqtrace.finish(rctx, dt)
+                hdrs.append((reqtrace.HEADER, reqtrace.to_header(rctx)))
+            return status, payload, hdrs
         self.metrics.inc("failed")
+        if rctx is not None:
+            # even an exhausted request leaves its forensic trail: the
+            # failed hop spans stitch into a completed (failed) trace
+            reqtrace.finish(rctx, time.perf_counter() - t0)
         err = json.dumps({
             "error": "no replica available"
             + (f" (last: {last_err})" if last_err else "")
@@ -367,7 +477,7 @@ class Router:
         if rep.port is None:
             return
         try:
-            status, payload = self._replica_request(
+            status, payload, _ = self._replica_request(
                 rep, "GET", "/healthz", timeout=2.0
             )
             doc = json.loads(payload or b"{}")
@@ -475,7 +585,7 @@ class Router:
                 if not ok:
                     continue
                 try:
-                    status, payload = self._replica_request(
+                    status, payload, _ = self._replica_request(
                         rep, "POST", "/reload",
                         json.dumps({"weights": weights}).encode(),
                     )

@@ -34,6 +34,12 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
 - ``GET /metrics.json`` — the ServeMetrics snapshot, one JSON object
   (the former ``/metrics`` payload; sweep logs and ``Client.metrics``
   use this).
+- ``GET /traces`` — completed request waterfalls (cross-process
+  stitched spans, ``telemetry/reqtrace.py``) as Chrome trace-event
+  JSON, loadable in Perfetto.  ``POST /classify`` accepts/propagates
+  the ``X-Sparknet-Trace`` context header and returns this replica's
+  span batch inline in an ``X-Sparknet-Spans`` response header so a
+  router stitches the full waterfall.
 
 The server is a ``ThreadingHTTPServer``: handler threads block on the
 batcher future while the single batcher worker feeds the device, so
@@ -61,6 +67,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..telemetry import reqtrace
 from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
 from .metrics import ServeMetrics
 
@@ -142,10 +149,15 @@ class InferenceServer:
                     # scrape-driven stall detection: a monitored server
                     # is exactly one that gets health-checked
                     _anomaly.observe_serve(outer.metrics)
+                    # SLO burn: every scrape feeds one p99-vs-budget
+                    # observation to the multi-window detector
+                    _anomaly.observe_slo(outer.metrics)
                     active = _anomaly.active()
                     status = outer.metrics.health()
                     if status == "ok" and any(
-                        a.get("kind") in ("queue_stall", "straggler")
+                        a.get("kind") in (
+                            "queue_stall", "straggler", "slo_burn"
+                        )
                         for a in active
                     ):
                         # a live stall/straggler advisory degrades the
@@ -200,9 +212,18 @@ class InferenceServer:
                         cluster=agg.snapshot() if agg is not None else None,
                         anomalies=_anomaly.active(),
                         model_name=outer.model_name,
+                        reqtrace=reqtrace.slowest(),
                     )
                     self._send(
                         200, page.encode(), "text/html; charset=utf-8"
+                    )
+                elif self.path == "/traces":
+                    # completed request waterfalls as Chrome trace JSON
+                    # (Perfetto-loadable; telemetry/reqtrace.py)
+                    self._send(
+                        200,
+                        json.dumps(reqtrace.export_chrome()).encode(),
+                        "application/json",
                     )
                 elif self.path == "/metrics":
                     # Prometheus text exposition: the process registry
@@ -247,6 +268,37 @@ class InferenceServer:
                         pass
                     self.connection.close()
                     return
+                # request trace (telemetry/reqtrace.py): adopt the
+                # router's context from the header, or mint a root one
+                # (single-process serving).  Disabled -> both None and
+                # every span call below is the shared no-op.
+                rctx = rhop = None
+                if reqtrace.enabled():
+                    rctx = reqtrace.parse(
+                        self.headers.get(reqtrace.HEADER)
+                    ) or reqtrace.mint()
+                    rhop = reqtrace.hop(rctx, "server.request")
+
+                def trace_headers(status):
+                    """Finish the server hop and hand the span batch
+                    back: roots stitch locally (the completed ring the
+                    dashboard reads); non-roots return spans inline in
+                    the response header for the router to stitch."""
+                    if rhop is None:
+                        return ()
+                    dur_s = rhop.finish(status=status)
+                    hdrs = [(reqtrace.HEADER, reqtrace.to_header(rctx))]
+                    if rctx.root:
+                        reqtrace.finish(rctx, dur_s or 0.0)
+                    else:
+                        hdrs.append((
+                            reqtrace.SPANS_HEADER,
+                            reqtrace.spans_header_value(
+                                reqtrace.take(rctx.trace_id)
+                            ),
+                        ))
+                    return hdrs
+
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
@@ -292,19 +344,25 @@ class InferenceServer:
                         raise KeyError("rows")
                 except (KeyError, ValueError, TypeError) as e:
                     outer.metrics.record_error()
-                    self._reply(400, {"error": f"bad request: {e}"})
+                    self._reply(400, {"error": f"bad request: {e}"},
+                                headers=trace_headers(400))
                     return
                 try:
-                    fut = outer.batcher.submit(rows)
+                    fut = outer.batcher.submit(
+                        rows, ctx=rhop.ctx if rhop is not None else None
+                    )
                 except Backpressure as e:
                     outer.metrics.record_error()
                     self._reply(
-                        503, {"error": str(e)}, headers=(("Retry-After", "1"),)
+                        503, {"error": str(e)},
+                        headers=(("Retry-After", "1"),)
+                        + tuple(trace_headers(503)),
                     )
                     return
                 except ValueError as e:
                     outer.metrics.record_error()
-                    self._reply(400, {"error": str(e)})
+                    self._reply(400, {"error": str(e)},
+                                headers=trace_headers(400))
                     return
                 try:
                     out = fut.result(timeout=outer.request_timeout_s)
@@ -315,13 +373,18 @@ class InferenceServer:
                     # (and counts it) instead of computing a reply
                     # nobody reads
                     fut.cancel()
-                    self._reply(504, {"error": "inference timed out"})
+                    self._reply(504, {"error": "inference timed out"},
+                                headers=trace_headers(504))
                     return
                 except DeadlineExceeded as e:
                     # shed before compute: overload, not caller error —
                     # 503 + Retry-After invites the client's backoff
+                    # (the shed shows up as a batcher.shed span on the
+                    # stitched waterfall)
                     self._reply(
-                        503, {"error": str(e)}, headers=(("Retry-After", "1"),)
+                        503, {"error": str(e)},
+                        headers=(("Retry-After", "1"),)
+                        + tuple(trace_headers(503)),
                     )
                     return
                 except Exception as e:
@@ -330,21 +393,27 @@ class InferenceServer:
                     # batcher already counted it — don't double-count.
                     code = 400 if isinstance(e, ValueError) else 500
                     self._reply(
-                        code, {"error": f"{type(e).__name__}: {e}"}
+                        code, {"error": f"{type(e).__name__}: {e}"},
+                        headers=trace_headers(code),
                     )
                     return
                 idx, probs = outer.engine.postprocess(out, top_k)
-                self._reply(
-                    200,
-                    {
-                        "indices": idx.tolist(),
-                        "probs": probs.tolist(),
-                        # generation tag: monotone across hot-swaps
-                        # (tests pin monotonicity), so clients and the
-                        # router can see a rolling update propagate
-                        "gen": getattr(outer.engine, "generation", 0),
-                    },
-                )
+                payload = {
+                    "indices": idx.tolist(),
+                    "probs": probs.tolist(),
+                    # generation tag: monotone across hot-swaps
+                    # (tests pin monotonicity), so clients and the
+                    # router can see a rolling update propagate
+                    "gen": getattr(outer.engine, "generation", 0),
+                }
+                with reqtrace.span(
+                    rhop.ctx if rhop is not None else None,
+                    "serve.serialize",
+                ) as sp:
+                    body = json.dumps(payload).encode()
+                    sp.note(bytes=len(body))
+                self._send(200, body, "application/json",
+                           trace_headers(200))
 
         self.default_top_k = default_top_k
         self.request_timeout_s = request_timeout_s
@@ -510,7 +579,7 @@ class Client:
         self.backoff_s = backoff_s
         self.max_backoff_s = max_backoff_s
 
-    def _once(self, method: str, path: str, payload=None):
+    def _once(self, method: str, path: str, payload=None, headers=None):
         import http.client
 
         conn = http.client.HTTPConnection(
@@ -518,10 +587,12 @@ class Client:
         )
         try:
             body = None if payload is None else json.dumps(payload)
-            headers = (
+            hdrs = (
                 {} if body is None else {"Content-Type": "application/json"}
             )
-            conn.request(method, path, body=body, headers=headers)
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
             retry_after = resp.getheader("Retry-After")
             data = json.loads(resp.read() or b"{}")
@@ -529,13 +600,18 @@ class Client:
         finally:
             conn.close()
 
-    def _request(self, method: str, path: str, payload=None):
+    def _request(self, method: str, path: str, payload=None, headers=None):
         import http.client
 
         for attempt in range(self.retries + 1):
             retry_after = None
             try:
-                status, data, retry_after = self._once(method, path, payload)
+                # headers only when present: the no-header call keeps
+                # the historical 3-arg shape (tests stub _once with it)
+                status, data, retry_after = (
+                    self._once(method, path, payload, headers)
+                    if headers else self._once(method, path, payload)
+                )
             except (OSError, http.client.HTTPException):
                 # dropped/reset connection (or the serve.conn_drop
                 # chaos point); the socket timeout bounds the attempt
@@ -570,10 +646,17 @@ class Client:
         """The JSON snapshot (the Prometheus text lives at /metrics)."""
         return self._request("GET", "/metrics.json")
 
-    def classify(self, rows, top_k: int = 5):
+    def classify(self, rows, top_k: int = 5, trace: Optional[str] = None):
+        """``trace``: an ``X-Sparknet-Trace`` header value (see
+        ``telemetry/reqtrace.py``) — lets a caller mint the trace
+        context client-side so it can correlate its own latency record
+        with the tier's stitched waterfall.  Retries reuse the same
+        trace id (a retried request is still one request)."""
         rows = np.asarray(rows)
+        headers = {reqtrace.HEADER: trace} if trace else None
         return self._request(
-            "POST", "/classify", {"rows": rows.tolist(), "top_k": top_k}
+            "POST", "/classify", {"rows": rows.tolist(), "top_k": top_k},
+            headers=headers,
         )
 
     def classify_cached(self, cache_key: str, top_k: int = 5):

@@ -34,6 +34,12 @@ cross-host sync, or snapshot I/O?  This package is the one substrate:
 - :mod:`~sparknet_tpu.telemetry.flight` — bounded crash flight
   recorder, dumped next to (and referenced from) ``supervise/records``
   failure records on any crash path.
+- :mod:`~sparknet_tpu.telemetry.reqtrace` — per-request tracing for
+  the serving tier: an ``X-Sparknet-Trace`` context minted at the
+  router, spans at every hop (dispatch/retry, server, batcher wait,
+  engine compute, serialize), replica span batches stitched from an
+  inline response header into Perfetto-loadable waterfalls
+  (``GET /traces``), and exemplar trace ids on the latency histograms.
 - :mod:`~sparknet_tpu.telemetry.dash` — the zero-dependency HTML
   dashboard the serve server mounts on ``GET /dash``.
 
@@ -50,7 +56,16 @@ import contextlib
 import os
 from typing import Optional
 
-from . import aggregate, anomaly, dash, exporter, flight, timeline, trace
+from . import (
+    aggregate,
+    anomaly,
+    dash,
+    exporter,
+    flight,
+    reqtrace,
+    timeline,
+    trace,
+)
 from .registry import (
     REGISTRY,
     Counter,
@@ -74,6 +89,7 @@ __all__ = [
     "finish_run",
     "flight",
     "install_for_training",
+    "reqtrace",
     "timeline",
     "trace",
 ]

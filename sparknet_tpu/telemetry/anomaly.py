@@ -6,7 +6,7 @@ first-class service.  This module is the deterministic half of both:
 no sampling, no model — fixed arithmetic over the aggregated stream,
 so a test can replay a synthetic stream and pin every firing.
 
-Three detector families:
+Four detector families:
 
 - :class:`StragglerDetector` — fed per-round, per-rank phase deltas by
   the cluster aggregator (rank 0).  A rank whose ``compiled_step`` /
@@ -22,6 +22,11 @@ Three detector families:
   looks is stalled.  Scrape-driven for serve (every ``/healthz`` and
   ``/dash`` hit observes) and flush-driven for the input pipeline (the
   periodic telemetry line polls the ``pipeline`` source).
+- :class:`SloBurnRateDetector` — multi-window SLO burn over the serve
+  p99-vs-``SPARKNET_SLO_P99_MS`` series (fast 5 m + slow 1 h windows),
+  scrape-driven from ``/healthz`` on replicas and the router; its
+  ``slo_burn`` advisory degrades ``/healthz`` and is the signal the
+  future traffic-shaped autoscaler admits/sheds on.
 
 Every firing does three things — increments the registry counter
 ``anomalies{kind=...}``, prints one structured ``anomaly: {...}`` JSON
@@ -258,6 +263,91 @@ class EmaMadDetector:
         return out
 
 
+# ------------------------------------------------------- SLO burn rate
+class SloBurnRateDetector:
+    """Multi-window SLO burn detection over the serving p99 series.
+
+    Each observation is one scrape's p99 latency (ms) judged against
+    the ``SPARKNET_SLO_P99_MS`` budget; the detector keeps the
+    (time, violated) pairs and fires when BOTH windows burn:
+
+    - **fast** window (default 5 min): ≥ ``fast_burn`` (default 0.5)
+      of its observations violate — the page-now signal, immune to a
+      single bad scrape;
+    - **slow** window (default 1 h): ≥ ``slow_burn`` (default 0.25)
+      violate — the error budget is genuinely burning, not one spike.
+
+    Deterministic: pure arithmetic over the sample deque, with the
+    clock injectable (``now=``) so tests replay a synthetic series and
+    pin every firing.  While the condition holds the advisory is
+    re-raised every ``refire_s`` so its 60 s TTL stays alive (the same
+    advisory board ``/healthz`` and the future autoscaler consume); a
+    clean observation arms the next full firing."""
+
+    def __init__(
+        self,
+        slo_ms: Optional[float] = None,
+        fast_s: Optional[float] = None,
+        slow_s: Optional[float] = None,
+        fast_burn: float = 0.5,
+        slow_burn: float = 0.25,
+        min_samples: int = 5,
+        refire_s: float = 30.0,
+        emit=print,
+        now=time.monotonic,
+    ):
+        self.slo_ms = (
+            slo_ms if slo_ms is not None
+            else _env_float("SPARKNET_SLO_P99_MS", 250.0)
+        )
+        self.fast_s = (
+            fast_s if fast_s is not None
+            else _env_float("SPARKNET_SLO_FAST_S", 300.0)
+        )
+        self.slow_s = (
+            slow_s if slow_s is not None
+            else _env_float("SPARKNET_SLO_SLOW_S", 3600.0)
+        )
+        self.fast_burn = fast_burn
+        self.slow_burn = slow_burn
+        self.min_samples = int(min_samples)
+        self.refire_s = refire_s
+        self.emit = emit
+        self._now = now
+        self._samples: deque = deque(maxlen=16384)
+        self._last_fire: Optional[float] = None
+
+    def observe(self, p99_ms: float) -> Optional[Dict[str, Any]]:
+        t = self._now()
+        self._samples.append((t, bool(p99_ms > self.slo_ms)))
+        while self._samples and t - self._samples[0][0] > self.slow_s:
+            self._samples.popleft()
+        slow = self._samples
+        fast = [(ts, v) for ts, v in slow if t - ts <= self.fast_s]
+        if len(fast) < self.min_samples or len(slow) < self.min_samples:
+            return None
+        fb = sum(v for _, v in fast) / len(fast)
+        sb = sum(v for _, v in slow) / len(slow)
+        if fb < self.fast_burn or sb < self.slow_burn:
+            self._last_fire = None  # clean look: next breach fires anew
+            return None
+        if self._last_fire is not None and t - self._last_fire < self.refire_s:
+            return None  # advisory already fresh; don't spam the log
+        self._last_fire = t
+        return fire(
+            "slo_burn",
+            key="p99",
+            severity="critical",
+            emit=self.emit,
+            p99_ms=round(float(p99_ms), 3),
+            slo_ms=self.slo_ms,
+            fast_burn=round(fb, 3),
+            slow_burn=round(sb, 3),
+            fast_window_s=self.fast_s,
+            slow_window_s=self.slow_s,
+        )
+
+
 # ---------------------------------------------------------- queue stalls
 class QueueStallDetector:
     """Work queued + completion counter frozen for ``observations``
@@ -317,6 +407,25 @@ _serve_stall: Optional[QueueStallDetector] = None
 _pipeline_stall: Optional[QueueStallDetector] = None
 _step_spike: Optional[EmaMadDetector] = None
 _loss_spike: Optional[EmaMadDetector] = None
+_slo_burn: Optional[SloBurnRateDetector] = None
+
+
+def observe_slo(latency) -> None:
+    """Scrape-driven SLO burn check over the serve-latency p99 series
+    — ``/healthz`` on both the replica server and the router call this
+    with their request-latency histogram (or anything carrying one as
+    ``.request_latency``).  No samples yet = no observation."""
+    global _slo_burn
+    hist = getattr(latency, "request_latency", latency)
+    try:
+        p99_us = hist.percentile(0.99)
+    except Exception:
+        return
+    if p99_us is None:
+        return
+    if _slo_burn is None:
+        _slo_burn = SloBurnRateDetector()
+    _slo_burn.observe(p99_us / 1000.0)
 
 
 def observe_serve(metrics) -> None:
@@ -370,4 +479,6 @@ def observe_loss(loss: float) -> None:
 def reset_detectors() -> None:
     """Fresh process-global detectors (test isolation)."""
     global _serve_stall, _pipeline_stall, _step_spike, _loss_spike
+    global _slo_burn
     _serve_stall = _pipeline_stall = _step_spike = _loss_spike = None
+    _slo_burn = None

@@ -44,6 +44,21 @@ _PHASE_COLORS = {
 }
 _OTHER_COLOR = ("#e34948", "#e66767")  # everything non-canonical folds here
 
+# fixed request-hop -> categorical slot assignment (light, dark) for
+# the slow-request waterfalls (telemetry/reqtrace.py span taxonomy);
+# same discipline as the phase palette: stable, never re-ranked.
+# router.retry deliberately wears the warning status color AND a text
+# flag in the row label — a retried hop is state, not just identity.
+_HOP_COLORS = {
+    "router.dispatch": ("#2a78d6", "#3987e5"),
+    "router.retry": ("#fab219", "#fab219"),
+    "server.request": ("#1baf7a", "#199e70"),
+    "batcher.wait": ("#eda100", "#c98500"),
+    "batcher.shed": ("#d03b3b", "#e66767"),
+    "engine.compute": ("#4a3aa7", "#9085e9"),
+    "serve.serialize": ("#e87ba4", "#d55181"),
+}
+
 # reserved status palette: state, never series identity
 _STATUS = {
     "good": "#0ca30c",
@@ -226,6 +241,66 @@ def _router_section(router: dict) -> str:
     )
 
 
+def _reqtrace_section(records: List[dict]) -> str:
+    """Slow-request panel: top-K stitched waterfalls by latency (the
+    router's completed traces, ``telemetry/reqtrace.py``).  One bar
+    per request; segments are the hops' duration shares (leaf spans —
+    batcher wait / engine compute / serialize — plus the router-side
+    attempt spans' unoverlapped remainder would double-count, so the
+    bar simply stacks every span's share of the trace's total span
+    time: attribution, not a timeline).  Rows with a retry hop are
+    flagged ``⟳ retried`` — never by color alone."""
+    if not records:
+        return ""
+    rows = []
+    for rec in sorted(records, key=lambda r: r.get("wall_ms", 0.0),
+                      reverse=True):
+        spans = rec.get("spans") or []
+        total = sum(s.get("dur", 0.0) for s in spans)
+        if total <= 0:
+            continue
+        segs = []
+        retried = False
+        for s in sorted(spans, key=lambda x: x.get("ts", 0)):
+            name = s.get("name", "?")
+            if name == "router.retry":
+                retried = True
+            dur_ms = s.get("dur", 0.0) / 1000.0
+            segs.append(
+                f'<div class="seg" data-hop="{_esc(name)}" '
+                f'style="width:{max(s.get("dur", 0.0) / total * 100, 0.4):.2f}%" '
+                f'title="{_esc(name)}: {dur_ms:.2f} ms"></div>'
+            )
+        label = rec.get("trace", "?")[:8]
+        flag = ' <span class="status-warning">⟳ retried</span>' if retried \
+            else ""
+        rows.append(
+            f'<div class="barrow"><div class="rank" '
+            f'title="{_esc(rec.get("trace"))}">{_esc(label)}</div>'
+            f'<div class="bar">{"".join(segs)}</div>'
+            f'<div class="ms">{rec.get("wall_ms", 0):.1f} ms{flag}</div>'
+            f"</div>"
+        )
+    seen: List[str] = []
+    for rec in records:
+        for s in rec.get("spans") or []:
+            n = s.get("name", "?")
+            if n not in seen:
+                seen.append(n)
+    legend = "".join(
+        f'<span class="key"><span class="swatch" '
+        f'data-hop="{_esc(n)}"></span>{_esc(n)}</span>'
+        for n in seen
+    )
+    return (
+        f'<section><h2>Slow requests <span class="muted">'
+        f"(top {len(rows)} stitched waterfalls by latency; full traces "
+        f"at /traces)</span></h2>"
+        f'<div class="bars">{"".join(rows)}</div>'
+        f'<div class="legend">{legend}</div></section>'
+    )
+
+
 def _anomaly_feed(events: List[dict]) -> str:
     if not events:
         return '<p class="muted">no anomalies recorded</p>'
@@ -256,6 +331,10 @@ def _phase_style_rules() -> str:
         sel = f'[data-phase="{name}"]' if name != "__other__" else ".seg,.swatch"
         light.append(f"{sel}{{background:{lc}}}")
         dark.append(f"{sel}{{background:{dc}}}")
+    for name, (lc, dc) in _HOP_COLORS.items():
+        sel = f'[data-hop="{name}"]'
+        light.append(f"{sel}{{background:{lc}}}")
+        dark.append(f"{sel}{{background:{dc}}}")
     # the catch-all comes FIRST so named phases override it
     light_css = light[-1] + "".join(light[:-1])
     dark_css = dark[-1] + "".join(dark[:-1])
@@ -273,11 +352,14 @@ def render_html(
     model_name: str = "net",
     refresh_s: int = 2,
     router: Optional[dict] = None,
+    reqtrace: Optional[List[dict]] = None,
 ) -> str:
     """The whole dashboard as one HTML string, rendered server-side
     from snapshots (the route passes live ones).  ``router``: a
     Router.snapshot() — adds the serving-tier section (replica table,
-    generations, retry counters) on the router's /dash."""
+    generations, retry counters) on the router's /dash.  ``reqtrace``:
+    a list of stitched trace records (``reqtrace.slowest()``) — adds
+    the slow-request waterfall panel."""
     cluster = cluster if cluster is not None else registry_snapshot.get(
         "cluster"
     )
@@ -320,6 +402,7 @@ def render_html(
   <span class="muted">rendered {time.strftime('%H:%M:%S')}, refreshes every {refresh_s}s</span>
 </header>
 {_router_section(router) if router is not None else ''}
+{_reqtrace_section(reqtrace) if reqtrace else ''}
 <section><h2>Serving</h2><div class="tiles">{''.join(tiles)}</div></section>
 <section><h2>Latency SLO <span class="muted">(p99 budget {budget:g} ms)</span></h2>
 <div class="tiles">{''.join(slo_tiles)}</div></section>
@@ -348,6 +431,8 @@ section {{ margin-bottom: 8px; }}
 .rank {{ width: 90px; text-align: right; color: #6e6d66; }}
 .bar {{ flex: 1; display: flex; gap: 2px; height: 14px; }}
 .seg {{ border-radius: 4px; min-width: 2px; }}
+.ms {{ min-width: 110px; text-align: left; color: #6e6d66;
+      font-variant-numeric: tabular-nums; }}
 .legend {{ margin: 8px 0 0 98px; }}
 .key {{ margin-right: 14px; white-space: nowrap; }}
 .swatch {{ display: inline-block; width: 10px; height: 10px;
@@ -367,7 +452,7 @@ ul.feed li {{ padding: 2px 0; border-bottom: 1px solid #efeee6;
 @media (prefers-color-scheme: dark) {{
   body {{ background: #1a1a19; color: #ffffff; }}
   .tile {{ background: #232322; border-color: #3a3a37; }}
-  .muted, .tile .label, .tile .sub, .rank {{ color: #c3c2b7; }}
+  .muted, .tile .label, .tile .sub, .rank, .ms {{ color: #c3c2b7; }}
   table.data th, table.data td {{ border-color: #3a3a37; }}
   ul.feed li {{ border-color: #2c2c2a; }}
 }}

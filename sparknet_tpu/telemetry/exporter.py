@@ -70,6 +70,18 @@ def _emit_gauge(lines: List[str], name: str, series) -> None:
         lines.append(f"{name}_max{_labels_str(key)} {snap['max']}")
 
 
+def _exemplar_suffix(h, i: int) -> str:
+    """OpenMetrics exemplar rendering: a bucket line gains a
+    ``# {trace_id="..."} value timestamp`` tail when a sampled request
+    trace (telemetry/reqtrace.py) landed in that bin — the link from a
+    p99 bucket on a graph to one concrete stitched waterfall."""
+    ex = getattr(h, "exemplars", None)
+    if not ex or i not in ex:
+        return ""
+    trace_id, value_s, ts = ex[i]
+    return f' # {{trace_id="{trace_id}"}} {value_s:g} {ts:.3f}'
+
+
 def _emit_histogram(lines: List[str], name: str, series) -> None:
     lines.append(f"# TYPE {name} histogram")
     for key, h in series:
@@ -78,10 +90,16 @@ def _emit_histogram(lines: List[str], name: str, series) -> None:
         for i, bound in enumerate(bounds):
             cum += h.counts[i]
             le_label = 'le="%g"' % (bound / 1e6)
-            lines.append(f"{name}_bucket{_merge_label(key, le_label)} {cum}")
+            lines.append(
+                f"{name}_bucket{_merge_label(key, le_label)} {cum}"
+                + _exemplar_suffix(h, i)
+            )
         cum += h.counts[len(bounds)]
         inf_label = 'le="+Inf"'
-        lines.append(f"{name}_bucket{_merge_label(key, inf_label)} {cum}")
+        lines.append(
+            f"{name}_bucket{_merge_label(key, inf_label)} {cum}"
+            + _exemplar_suffix(h, len(bounds))
+        )
         lines.append(f"{name}_sum{_labels_str(key)} {h.total_us / 1e6:g}")
         lines.append(f"{name}_count{_labels_str(key)} {h.n}")
 

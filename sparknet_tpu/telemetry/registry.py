@@ -48,18 +48,33 @@ while _b < 300e6:
 
 
 class LatencyHistogram:
-    """Log-binned latency histogram with percentile readout."""
+    """Log-binned latency histogram with percentile readout.
+
+    ``observe(..., exemplar=("trace id", seconds))`` additionally pins
+    the newest exemplar on the bin the observation landed in — the
+    OpenMetrics hook linking a latency bucket to one concrete request
+    trace (``telemetry/reqtrace.py``); rendered by the Prometheus
+    exporter.  The exemplar table is lazy (None until the first one)
+    and bounded at one entry per bin."""
 
     def __init__(self):
         self.counts = [0] * (len(_BOUNDS_US) + 1)
         self.n = 0
         self.total_us = 0.0
+        self.exemplars: Optional[Dict[int, tuple]] = None
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, exemplar=None) -> None:
         us = max(seconds, 0.0) * 1e6
-        self.counts[bisect.bisect_left(_BOUNDS_US, us)] += 1
+        i = bisect.bisect_left(_BOUNDS_US, us)
+        self.counts[i] += 1
         self.n += 1
         self.total_us += us
+        if exemplar is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[i] = (
+                str(exemplar[0]), float(exemplar[1]), time.time()
+            )
 
     def percentile(self, q: float) -> Optional[float]:
         """Upper bound (µs) of the bin holding the q-quantile, or None
