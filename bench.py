@@ -40,6 +40,18 @@ Env knobs:
                              + rolling hot-swap (zero failed requests
                              is the bar) and the persistent compile
                              cache's warm-restart warmup cut
+  BENCH_MODEL=quant_serving  quantized-inference A/B (ISSUE 12):
+                             f32/bf16/int8 engine throughput + top-1
+                             agreement on a fixed batch + fingerprint
+                             no-aliasing + a live router 50/50 quant
+                             A/B (docs/QUANTIZATION.md; speedup floors
+                             are accelerator gates — XLA CPU has no
+                             int8 GEMM path, records are labeled)
+  BENCH_MODEL=fusion         dispatch-fusion A/B (ISSUE 12): legacy
+                             vs SPARKNET_FUSED_STEP train loop step
+                             ms, interleaved rounds, plus the
+                             scripts/fusion_audit.py record of a
+                             traced legacy run
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
   BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
@@ -807,6 +819,13 @@ def bench_serving_tier(platform: str) -> dict:
 
         cache_root = os.path.join(tmp, "compile_cache")
         portfile = os.path.join(tmp, "router.json")
+        # pin the tier's backend explicitly: every replica must serve
+        # on the SAME platform the in-process arms measured, or the
+        # A/B is apples-to-oranges (ISSUE 12 satellite — on this
+        # 1-CPU container that means JAX_PLATFORMS=cpu uniformly)
+        child_env = dict(os.environ)
+        if platform == "cpu":
+            child_env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.Popen(
             [sys.executable, "-m", "sparknet_tpu.tools.serve",
              "--model", deploy, "--weights", weights0,
@@ -815,7 +834,7 @@ def bench_serving_tier(platform: str) -> dict:
              "--portfile", portfile,
              "--run-dir", os.path.join(tmp, "run"),
              "--compile-cache", cache_root],
-            cwd=_HERE,
+            cwd=_HERE, env=child_env,
         )
         deadline = time.time() + 600
         while not os.path.exists(portfile):
@@ -923,6 +942,324 @@ def bench_serving_tier(platform: str) -> dict:
         if proc is not None and proc.poll() is None:
             proc.kill()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_quant_serving(platform: str) -> dict:
+    """Quantized-inference A/B (``BENCH_MODEL=quant_serving``, ISSUE 12).
+
+    Four measurements, one record:
+
+    1. **Engine throughput per precision** (in-process, equal load):
+       the same deploy net + snapshot served f32 / bf16 / int8 through
+       the closed-loop generator — requests/s, p50/p99, resident
+       weight bytes per mode.  ``int8_speedup``/``bf16_speedup`` are
+       the headline ratios; they are MXU numbers — on hosts with no
+       int8 GEMM path (this 1-CPU container: XLA CPU lowers s8xs8
+       convs to a generic loop ~8x slower than Eigen f32) the ratios
+       go *below* 1 and the record says so (``host_cpus``,
+       ``speedup_gate``); ``bench_diff`` applies the 1.5x/1.2x floors
+       to accelerator records only.  The memory side is
+       platform-independent: ``int8_weight_compression`` (~3.96x on
+       cifar10_quick) is real everywhere.
+    2. **Top-1 agreement** on a fixed seeded CIFAR-shaped batch:
+       f32-vs-int8 and f32-vs-bf16 disagreement percent — the <0.5%
+       accuracy bar, gated absolutely by ``bench_diff``.
+    3. **Compile-cache no-aliasing**: the three engines' fingerprints
+       must be pairwise distinct (precision is part of the key).
+    4. **Live router A/B** over the wire: an f32 and an int8 replica
+       behind one Router with ``quant_ab=0.5`` take a loadgen burst —
+       zero failed requests, both variants observed in responses
+       (``served_quants``), realized per-variant answer counts from
+       the replica table.
+    """
+    import shutil
+    import tempfile
+
+    from sparknet_tpu.serve import quantize as quantize_mod
+    from sparknet_tpu.serve.batcher import MicroBatcher
+    from sparknet_tpu.serve.engine import InferenceEngine
+    from sparknet_tpu.serve.loadgen import run_http_loadgen, run_loadgen
+    from sparknet_tpu.serve.metrics import ServeMetrics
+    from sparknet_tpu.serve.router import Router
+    from sparknet_tpu.serve.server import InferenceServer
+    from sparknet_tpu.solver import snapshot as snap
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    deploy = os.path.join(zoo, "cifar10_quick_deploy.prototxt")
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 150))
+    sizes = (1, 2, 5, 8, 3)
+    buckets = (1, 8, 32)
+    concurrency = 3
+    modes = ("f32", "bf16", "int8")
+
+    tmp = tempfile.mkdtemp(prefix="bench_quant_")
+    try:
+        # one snapshot all precisions serve: the int8 arm captures its
+        # scales from this manifest-verified file (the hot-swap path)
+        seed_eng = InferenceEngine.from_files(deploy, buckets=(1,))
+        w0 = os.path.join(tmp, "w_iter_10.solverstate.npz")
+        snap.save_state(
+            w0,
+            params=jax.device_get(seed_eng.params),
+            state=jax.device_get(seed_eng.state),
+        )
+
+        engines = {}
+        arms = {}
+        for mode in modes:
+            eng = InferenceEngine.from_files(
+                deploy, w0, buckets=buckets, quant=mode
+            ).warmup()
+            engines[mode] = eng
+            metrics = ServeMetrics(buckets)
+            eng.metrics = metrics
+            batcher = MicroBatcher(
+                eng, metrics=metrics, mode="continuous",
+                max_latency_us=20_000,
+            )
+            rec = run_loadgen(
+                eng, n_requests=n_req, sizes=sizes,
+                concurrency=concurrency, batcher=batcher,
+                metrics=metrics,
+            )
+            batcher.drain()
+            arms[mode] = {
+                "requests_per_sec": rec["value"],
+                "p50_ms": rec["p50_ms"],
+                "p99_ms": rec["p99_ms"],
+                "errors": rec["errors"],
+                "weight_bytes": quantize_mod.tree_bytes(eng.params),
+            }
+        f32_rps = arms["f32"]["requests_per_sec"] or 1e-9
+        int8_speedup = round(arms["int8"]["requests_per_sec"] / f32_rps, 3)
+        bf16_speedup = round(arms["bf16"]["requests_per_sec"] / f32_rps, 3)
+
+        # ---- top-1 agreement on one fixed batch (the accuracy bar)
+        rng = np.random.default_rng(0)
+        probe = rng.normal(size=(256, 32, 32, 3)).astype(np.float32)
+        ref_idx, _ = engines["f32"].topk(probe, 1)
+        disagree = {}
+        for mode in ("bf16", "int8"):
+            idx, _ = engines[mode].topk(probe, 1)
+            disagree[mode] = round(
+                100.0 * float((idx[:, 0] != ref_idx[:, 0]).mean()), 3
+            )
+
+        # ---- fingerprint no-aliasing across precisions
+        fps = {mode: engines[mode].fingerprint for mode in modes}
+
+        # ---- live router A/B: f32 + int8 replicas, 50/50 preference
+        servers = {}
+        for mode in ("f32", "int8"):
+            eng = engines[mode]
+            metrics = ServeMetrics(buckets)
+            servers[mode] = InferenceServer(
+                eng,
+                batcher=MicroBatcher(
+                    eng, metrics=metrics, mode="continuous",
+                    max_latency_us=20_000,
+                ),
+                metrics=metrics,
+                port=0,
+            ).start()
+        router = Router(
+            [(s.host, s.port) for s in servers.values()],
+            quant_ab=0.5,
+        ).start()
+        try:
+            router.wait_healthy(timeout_s=60)
+            lg = run_http_loadgen(
+                router.host, router.port, (32, 32, 3),
+                n_requests=n_req, sizes=sizes, concurrency=concurrency,
+            )
+            hz = router.healthz()
+            answered = {
+                (r["quant"] or "f32"): r["forwarded"]
+                for r in hz["replicas"]
+            }
+        finally:
+            router.stop()
+            for s in servers.values():
+                s.stop()
+
+        return {
+            "metric": "quant_serving_int8_speedup",
+            "value": int8_speedup,
+            "unit": "x",
+            "vs_baseline": None,
+            "platform": platform,
+            "requests_per_arm": n_req,
+            "sizes": list(sizes),
+            "buckets": list(buckets),
+            "concurrency": concurrency,
+            "arms": arms,
+            "int8_speedup": int8_speedup,
+            "bf16_speedup": bf16_speedup,
+            # accelerator-only floors: XLA CPU has no int8 GEMM path,
+            # so on host_cpus-class runs these ratios are labeled
+            # informational and bench_diff skips the 1.5x/1.2x floors
+            "speedup_gate": (
+                "informational-on-cpu" if platform == "cpu" else "gated"
+            ),
+            "int8_disagree_pct": disagree["int8"],
+            "bf16_disagree_pct": disagree["bf16"],
+            "agreement_rows": len(probe),
+            "int8_weight_compression": round(
+                arms["f32"]["weight_bytes"] / arms["int8"]["weight_bytes"],
+                3,
+            ),
+            "fingerprints": fps,
+            "fingerprints_distinct": len(set(fps.values())) == len(fps),
+            "ab": {
+                "quant_ab": 0.5,
+                "failed_requests": lg.get("failed_requests"),
+                "served_quants": lg.get("served_quants"),
+                "answered": answered,
+                "p50_ms": lg.get("p50_ms"),
+                "p99_ms": lg.get("p99_ms"),
+            },
+            "host_cpus": os.cpu_count(),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_fusion(platform: str) -> dict:
+    """Dispatch-fusion A/B (``BENCH_MODEL=fusion``, ISSUE 12): the
+    audit-driven train-step fix, measured.
+
+    The legacy loop pays two extra host dispatches per iteration (the
+    ``jax.random.split`` program + the iteration counter's scalar
+    device_put); ``scripts/fusion_audit.py`` surfaces them as
+    unattributed gap in any ``--trace`` capture, and the fused step
+    (``SPARKNET_FUSED_STEP``, solver/trainer.py) folds them into the
+    compiled program — bitwise-identical weights (pinned by
+    tests/test_fusion.py), strictly fewer dispatches.
+
+    Three interleaved legacy/fused rounds on one small net, median of
+    per-round speedups (the same pairing discipline as the reqtrace
+    overhead arm — host scheduling noise on this box is larger than
+    the effect for big steps).  The record embeds the audit of a
+    traced legacy run, so the finding and the fix travel together."""
+    import subprocess
+    import tempfile
+
+    from sparknet_tpu.proto.caffe_pb import SolverParameter, load_net
+    from sparknet_tpu.solver.trainer import Solver
+    from sparknet_tpu.telemetry import timeline as _ttl
+    from sparknet_tpu.telemetry import trace as _trace
+
+    net_text = """
+name: "fusion_bench"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 64
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+        bottom: "label" top: "loss" }
+"""
+    net_param = load_net(net_text, is_path=False)
+    sp = SolverParameter(
+        base_lr=0.01, lr_policy="fixed", max_iter=100000
+    )
+    shapes = {"data": (16, 256), "label": (16,)}
+    iters = int(os.environ.get("BENCH_ITERS", 150))
+    rounds = 3
+
+    rng = np.random.default_rng(3)
+    one = {
+        "data": rng.normal(size=shapes["data"]).astype(np.float32),
+        "label": rng.integers(0, 10, size=shapes["label"]).astype(
+            np.int32
+        ),
+    }
+
+    def feed():
+        while True:
+            yield one
+
+    solver = Solver(sp, shapes, net_param=net_param, seed=0)
+    # compile + warm BOTH programs outside the timed rounds
+    for fused in (False, True):
+        solver._fuse_host = fused
+        solver.step(feed(), 5)
+    jax.block_until_ready(solver.params)
+
+    round_recs = []
+    for _ in range(rounds):
+        pair = {}
+        for arm, fused in (("legacy", False), ("fused", True)):
+            solver._fuse_host = fused
+            t0 = time.perf_counter()
+            solver.step(feed(), iters)
+            jax.block_until_ready(solver.params)
+            pair[arm] = round(
+                1000 * (time.perf_counter() - t0) / iters, 4
+            )
+        pair["speedup"] = round(pair["legacy"] / pair["fused"], 3)
+        round_recs.append(pair)
+    speedups = sorted(p["speedup"] for p in round_recs)
+    speedup = speedups[len(speedups) // 2]
+    legacy_ms = sorted(p["legacy"] for p in round_recs)[rounds // 2]
+    fused_ms = sorted(p["fused"] for p in round_recs)[rounds // 2]
+
+    # ---- the audit that grounds the fix: trace a short LEGACY run
+    # (fenced timeline, so phase spans land in the trace) and run
+    # scripts/fusion_audit.py over the capture
+    audit = None
+    tmp = tempfile.mkdtemp(prefix="bench_fusion_")
+    try:
+        trace_path = os.path.join(tmp, "legacy_trace.json")
+        _trace.enable(trace_path)
+        tl = _ttl.Timeline(fence=True)
+        audit_solver = Solver(sp, shapes, net_param=net_param, seed=0)
+        audit_solver._fuse_host = False
+        audit_solver.timeline = tl
+        tl.start()
+        audit_solver.step(feed(), 30)
+        tl.stop()
+        _trace.write(trace_path)
+        _trace.disable()
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_HERE, "scripts", "fusion_audit.py"),
+             trace_path, "--json", "--informational"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            audit = json.loads(out.stdout.strip().splitlines()[-1])
+            # keep the record compact: shares + findings, not every
+            # transition
+            audit.pop("transitions", None)
+    except Exception as e:  # the audit arm must never sink the bench
+        audit = {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "metric": "fusion_step_ms_fused",
+        "value": fused_ms,
+        "unit": "ms",
+        "vs_baseline": None,
+        "platform": platform,
+        "iters_per_round": iters,
+        "rounds": round_recs,
+        "step_ms_legacy": legacy_ms,
+        "step_ms_fused": fused_ms,
+        # >1.0 = the audit-driven fix cut step time (bench_diff's
+        # absolute bar); bitwise weight equality is pinned in tier-1
+        "fusion_speedup": speedup,
+        "fusion_step_cut_pct": round(100 * (1 - fused_ms / legacy_ms), 1),
+        "audit": audit,
+        "host_cpus": os.cpu_count(),
+    }
 
 
 def bench_comm(platform: str) -> dict:
@@ -1234,6 +1571,10 @@ def main() -> None:
         runner = bench_data_plane
     elif mode == "serving_tier":
         runner = bench_serving_tier
+    elif mode == "quant_serving":
+        runner = bench_quant_serving
+    elif mode == "fusion":
+        runner = bench_fusion
     elif mode in IMAGENET_ARCHS:
         runner = functools.partial(bench_imagenet, arch=mode)
     else:
@@ -1242,7 +1583,7 @@ def main() -> None:
         raise ValueError(
             f"BENCH_MODEL={mode!r}: want "
             f"bert|input_pipeline|data_plane|comm|sharding|serving_tier|"
-            f"{'|'.join(IMAGENET_ARCHS)}"
+            f"quant_serving|fusion|{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
         with jax.profiler.trace(profile_dir):
@@ -1287,6 +1628,10 @@ if __name__ == "__main__":
                         if mode == "data_plane"
                         else "serving_tier_p99_ms_continuous"
                         if mode == "serving_tier"
+                        else "quant_serving_int8_speedup"
+                        if mode == "quant_serving"
+                        else "fusion_step_ms_fused"
+                        if mode == "fusion"
                         else f"{mode}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,

@@ -141,9 +141,11 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
                 f"{rise:+.1%}")
     # sharding records (BENCH_MODEL=sharding): unified-vs-legacy step
     # time, compile wall time, and the donated-buffer peak-memory
-    # estimate — all lower-is-better
+    # estimate — all lower-is-better.  The fusion A/B's two step
+    # times (BENCH_MODEL=fusion) ride the same direction.
     for key in ("unified_step_ms", "legacy_step_ms", "compile_s_unified",
-                "compile_s_legacy", "donated_peak_mb"):
+                "compile_s_legacy", "donated_peak_mb",
+                "step_ms_fused", "step_ms_legacy"):
         a, b = find_key(old, key), find_key(new, key)
         if a and b:
             rise = (b - a) / a
@@ -173,6 +175,48 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
         over = b > args.reqtrace_pct
         add("reqtrace_overhead_pct", a, b, "", over,
             f"≤{args.reqtrace_pct:g}% is the bar" if over else "ok")
+    # quantized-inference records (BENCH_MODEL=quant_serving): the
+    # accuracy and cache-key bars are ABSOLUTE and platform-blind;
+    # the speed floors (int8 >= 1.5x, bf16 >= 1.2x) gate accelerator
+    # records only — XLA CPU has no int8 GEMM path, and such records
+    # carry speedup_gate="informational-on-cpu" to say so.  The
+    # weight-bytes compression is real on every platform and gets its
+    # own absolute floor.
+    for key in ("int8_disagree_pct", "bf16_disagree_pct"):
+        b = new.get(key)
+        if b is not None:
+            over = b > args.quant_disagree_pct
+            add(key, old.get(key), b, "", over,
+                f"≤{args.quant_disagree_pct:g}% is the bar"
+                if over else "ok")
+    fd = new.get("fingerprints_distinct")
+    if fd is not None:
+        add("fingerprints_distinct", None, float(bool(fd)), "",
+            not fd,
+            "ok" if fd else "precision compile-cache keys ALIAS")
+    b = new.get("int8_weight_compression")
+    if b is not None:
+        low = b < args.int8_bytes_x
+        add("int8_weight_compression", old.get("int8_weight_compression"),
+            b, "", low,
+            f"≥{args.int8_bytes_x:g}x is the bar" if low else "ok")
+    speed_gated = new.get("speedup_gate") != "informational-on-cpu"
+    for key, floor in (("int8_speedup", args.int8_speedup_min),
+                       ("bf16_speedup", args.bf16_speedup_min)):
+        b = new.get(key)
+        if b is not None:
+            bad = speed_gated and b < floor
+            add(key, old.get(key), b, "", bad,
+                f"≥{floor:g}x floor" if bad
+                else ("cpu-informational" if not speed_gated else "ok"))
+    # fusion records (BENCH_MODEL=fusion): the audit-driven fix must
+    # actually cut step time — an absolute >1.0x bar, like
+    # failed_requests' zero
+    b = new.get("fusion_speedup")
+    if b is not None:
+        bad = b <= 1.0
+        add("fusion_speedup", old.get("fusion_speedup"), b, "", bad,
+            "audit fix must cut step_ms" if bad else "ok")
     # served-generation coverage (hot-swap observability): count of
     # distinct generations answered during the run — informational
     gens_old = (old.get("tier") or {}).get("served_generations")
@@ -221,6 +265,18 @@ def main(argv=None) -> int:
     ap.add_argument("--reqtrace-pct", type=float, default=2.0,
                     help="max tolerated request-tracing p50 overhead, "
                          "percent of the tracing-off p50 (default 2)")
+    ap.add_argument("--quant-disagree-pct", type=float, default=0.5,
+                    help="max tolerated quantized top-1 disagreement "
+                         "vs the f32 reference, percent (default 0.5)")
+    ap.add_argument("--int8-speedup-min", type=float, default=1.5,
+                    help="int8 serve-throughput floor vs f32, x "
+                         "(accelerator records only; default 1.5)")
+    ap.add_argument("--bf16-speedup-min", type=float, default=1.2,
+                    help="bf16 serve-throughput floor vs f32, x "
+                         "(accelerator records only; default 1.2)")
+    ap.add_argument("--int8-bytes-x", type=float, default=1.5,
+                    help="int8 resident-weight-bytes compression "
+                         "floor vs f32, x (default 1.5)")
     ap.add_argument("--informational", action="store_true",
                     help="print the table but always exit 0 (the "
                          "check.sh mode)")

@@ -119,6 +119,16 @@ for e in evs:
 EOF
 then
   echo "check.sh: telemetry smoke OK (valid trace + step-time table)"
+  # ---- fusion audit (ISSUE 12): the dispatch-gap audit must parse the
+  # check run's own trace — informational (findings don't gate), but a
+  # parse failure does.  All its timing comes from the trace file; the
+  # quant smoke below asserts it never grows an ad-hoc clock.
+  if python scripts/fusion_audit.py "$SMOKE_DIR/trace.json" --informational; then
+    echo "check.sh: fusion audit OK (parsed the telemetry smoke trace)"
+  else
+    echo "check.sh: fusion AUDIT FAILED on $SMOKE_DIR/trace.json"
+    exit 1
+  fi
   rm -rf "$SMOKE_DIR"
 else
   echo "check.sh: telemetry SMOKE FAILED — log tail:"
@@ -342,6 +352,20 @@ if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py; then
   echo "check.sh: serving smoke OK (replica kill + hot-swap, 0 failed, cache-hit respawn, stitched waterfall)"
 else
   echo "check.sh: serving SMOKE FAILED"
+  exit 1
+fi
+
+# ---- quant smoke (ISSUE 12): an int8 1-replica tier hot-swaps a
+# manifest-verified snapshot (scales re-captured at swap time), the
+# quant tag rides /healthz and /classify next to gen, f32-vs-int8
+# top-1 agreement holds the <0.5% disagreement bar, the persistent
+# compile cache keys f32 and int8 into DISTINCT fingerprint dirs, and
+# the fusion-audit/quantize code contains no ad-hoc perf_counter
+# clocks (allowlist frozen).
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/quant_smoke.py; then
+  echo "check.sh: quant smoke OK (int8 hot-swap + agreement + precision-distinct cache)"
+else
+  echo "check.sh: quant SMOKE FAILED"
   exit 1
 fi
 

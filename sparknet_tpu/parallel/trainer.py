@@ -91,6 +91,10 @@ class ParallelSolver(Solver):
         self.layout: Optional[partition_mod.Layout] = layout
         self._plan: Optional[partition_mod.Plan] = None
         super().__init__(solver, input_shapes, **kw)
+        # the parallel step builders below own their dispatch shape
+        # (sharded batches, explicit reduce programs): the base
+        # solver's fused host-dispatch wrapper must never shadow them
+        self._fuse_host = False
         if mesh is None:
             mesh = layout.mesh() if layout is not None else make_mesh()
         self.mesh = mesh

@@ -31,6 +31,11 @@ engine behind a batched request queue:
   manifest-verified solverstates roll into serving automatically.
 - :mod:`~sparknet_tpu.serve.compile_cache` — per-net persistent XLA
   compile cache; replica restarts skip AOT warmup.
+- :mod:`~sparknet_tpu.serve.quantize` — bf16/int8 engine variants:
+  per-channel scales captured from verified snapshots at hot-swap
+  time, int8 matmul/conv with f32 rescale, precision-keyed compile
+  caches, and the router's live ``--quant-ab`` A/B
+  (docs/QUANTIZATION.md).
 
 See docs/SERVING.md for the architecture and knob reference.
 """

@@ -38,19 +38,23 @@ from typing import Any, Dict, Optional
 
 
 def net_fingerprint(
-    net, params: Any, state: Any, compute_dtype=None, layout=None
+    net, params: Any, state: Any, compute_dtype=None, layout=None,
+    quant: Any = None,
 ) -> str:
     """16-hex content hash of the net's *architecture* — stable across
     processes and weight versions, different for any structural change.
 
     Covers: layer (name, type, tops, bottoms), blob shapes, input
     names, the param/state pytrees' paths + shapes + dtypes, the
-    compute dtype, and (when serving through a multi-device
+    compute dtype, (when serving through a multi-device
     :class:`~sparknet_tpu.parallel.partition.Layout`) the layout
-    fingerprint — the same arch compiled under two different partition
-    rule tables produces different executables, so their compile
-    caches must never alias.  Weight VALUES are deliberately excluded
-    (see module docstring)."""
+    fingerprint, and (quantized engines, ``serve/quantize.py``) the
+    quantization mode — the same arch compiled under two different
+    partition rule tables or precisions produces different
+    executables, so their compile caches must never alias.  ``quant``
+    is folded in only when set and non-f32, keeping pre-quantization
+    fingerprints (and the persistent caches they key) stable.  Weight
+    VALUES are deliberately excluded (see module docstring)."""
     import jax
 
     def tree_sig(tree):
@@ -80,6 +84,8 @@ def net_fingerprint(
         from ..parallel import partition
 
         doc["layout"] = partition.layout_fingerprint(layout)
+    if quant is not None and str(quant) != "f32":
+        doc["quant"] = str(quant)
     raw = json.dumps(doc, sort_keys=True).encode()
     return hashlib.sha256(raw).hexdigest()[:16]
 

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..telemetry import trace as _trace
+from . import quantize as _quantize
 from .compile_cache import net_fingerprint
 
 Rows = Union[np.ndarray, Dict[str, np.ndarray]]
@@ -85,6 +86,7 @@ class InferenceEngine:
         compute_dtype: Any = jnp.float32,
         metrics=None,
         layout=None,
+        quant: Any = None,
     ):
         """``net``: an ``XLANet`` (any phase; TEST semantics are forced
         at apply time). ``output``: blob to return — defaults to the
@@ -96,9 +98,24 @@ class InferenceEngine:
         sharding trees training uses (one sharded compile path for
         train and serve), request rows shard over the batch axis when
         the bucket divides, and the fingerprint (hence both compile
-        caches) is keyed by the layout so layouts never alias."""
+        caches) is keyed by the layout so layouts never alias.
+        ``quant``: ``"f32"`` (default), ``"bf16"`` (weights cast to
+        bf16 at install, bf16 compute) or ``"int8"`` (per-channel
+        int8 weights + in-graph per-row activation quantization,
+        ``serve/quantize.py``) — the mode folds into the fingerprint
+        so the compile caches never alias precisions."""
         if not buckets:
             raise ValueError("InferenceEngine: need at least one bucket")
+        self.quant = _quantize.normalize_mode(quant)
+        if self.quant == "bf16":
+            # the weights-as-arguments bf16 mode implies bf16 compute
+            compute_dtype = jnp.bfloat16
+        if self.quant == "int8" and layout is not None:
+            raise ValueError(
+                "InferenceEngine: quant='int8' with a multi-device "
+                "layout is not supported (quantize the replicated "
+                "serving shape; layouts keep f32/bf16)"
+            )
         self.net = net
         self.buckets: Tuple[int, ...] = tuple(sorted({int(b) for b in buckets}))
         if self.buckets[0] < 1:
@@ -143,7 +160,21 @@ class InferenceEngine:
         """Normalize + publish a weight set (init and swap share this):
         device arrays in, fingerprint recomputed — a structural change
         (different arch) changes the executable-cache key, so stale
-        executables are unreachable by construction."""
+        executables are unreachable by construction.
+
+        Quantized modes transform here, at install time — which for a
+        ``swap_from_file`` means scales are captured from the verified
+        snapshot at hot-swap time, never cached across generations.  A
+        host-side f32 reference of the incoming tree is kept so the
+        next file swap merges onto full-precision weights, not onto a
+        quantized tree."""
+        if self.quant != "f32":
+            self._ref_params = jax.device_get(params)
+            self._ref_state = jax.device_get(state)
+            if self.quant == "int8":
+                params = _quantize.quantize_tree(self.net, params)
+            else:
+                params = _quantize.bf16_tree(params)
         if self._mesh is not None:
             # per-leaf rule-table placement: the SAME sharding trees a
             # training run with this layout uses (recomputed per swap —
@@ -161,7 +192,8 @@ class InferenceEngine:
             to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
             params, state = to_dev(params), to_dev(state)
         self.fingerprint = net_fingerprint(
-            self.net, params, state, self.compute_dtype, layout=self.layout
+            self.net, params, state, self.compute_dtype,
+            layout=self.layout, quant=self.quant,
         )
         self.params = params
         self.state = state
@@ -187,9 +219,16 @@ class InferenceEngine:
     def swap_from_file(self, weights: str) -> int:
         """Load + verify + swap from any weights artifact.  Snapshot
         files are manifest-verified by the loader (PR 3): a torn file
-        raises before the swap, so the old generation keeps serving."""
+        raises before the swap, so the old generation keeps serving.
+        Quantized engines merge onto the retained f32 reference tree
+        (never onto int8/bf16 leaves) and re-capture scales in
+        ``_install``."""
+        if self.quant != "f32":
+            base_params, base_state = self._ref_params, self._ref_state
+        else:
+            base_params, base_state = self.params, self.state
         params, state = load_weights_any(
-            self.net, self.params, self.state, weights
+            self.net, base_params, base_state, weights
         )
         return self.swap(params, state, source=weights)
 
@@ -232,7 +271,12 @@ class InferenceEngine:
         return jnp.int32 if name == "label" else self.compute_dtype
 
     def _fwd(self, params, state, batch):
-        blobs, _ = self.net.apply(params, state, batch, train=False, rng=None)
+        if self.quant == "int8":
+            blobs, _ = _quantize.apply_int8(self.net, params, state, batch)
+        else:
+            blobs, _ = self.net.apply(
+                params, state, batch, train=False, rng=None
+            )
         return blobs[self.output]
 
     def _executable(self, bucket: int, weights=None):

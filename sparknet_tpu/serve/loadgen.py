@@ -14,6 +14,7 @@ serving cost is shape-dependent, not value-dependent.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional, Sequence
@@ -109,6 +110,10 @@ def run_loadgen(
         "p99_ms": lat["p99_ms"],
         "errors": len(errors),
         "error_samples": errors[:3],
+        # serving throughput is host-bound on small nets: every record
+        # names the cores it ran on (the PR 2 input_pipeline caveat —
+        # a 1-CPU container's numbers are labeled, not trusted)
+        "host_cpus": os.cpu_count(),
         "metrics": snap,
     }
 
@@ -155,6 +160,7 @@ def run_http_loadgen(
     failed_traces = []
     samples = []  # (request index, trace id, latency seconds)
     generations = set()
+    quants = set()
 
     def worker(wid: int):
         rng = np.random.default_rng(seed + wid)
@@ -196,6 +202,8 @@ def run_http_loadgen(
                 samples.append((i, tid, dt))
                 if "gen" in resp:
                     generations.add(int(resp["gen"]))
+                if resp.get("quant"):
+                    quants.add(str(resp["quant"]))
 
     t0 = time.perf_counter()
     threads = [
@@ -245,6 +253,10 @@ def run_http_loadgen(
         "failed_request_traces": failed_traces[:20],
         "slow_request_traces": slow_traces,
         "served_generations": sorted(generations),
+        # every precision variant that answered (the quant A/B's
+        # client-side evidence, like served_generations for hot-swap)
+        "served_quants": sorted(quants),
+        "host_cpus": os.cpu_count(),
     }
 
 

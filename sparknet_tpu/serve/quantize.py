@@ -1,0 +1,269 @@
+"""Quantized inference — int8/bf16 weight trees as engine variants.
+
+FireCaffe (PAPERS.md, arXiv:1511.00175) and the PHAST port
+(arXiv:2005.13076) both attack arithmetic cost and memory traffic per
+step; on the serving side the same lever is precision.  PR 6 already
+quantizes *gradients* on the wire — this module quantizes *weights and
+activations* for inference:
+
+- **Scale capture** is per-output-channel symmetric absmax over the
+  weight's leading axes (HWIO convs and (in, out) matmuls both keep
+  the output channel LAST, so one rule covers both):
+  ``scale[c] = max(|W[..., c]|) / 127``.  Scales are captured from a
+  **manifest-verified snapshot** at hot-swap time — the engine's
+  ``_install`` quantizes whatever ``swap()`` hands it, and
+  ``quantize_snapshot`` walks ``snapshot.newest_verified_solverstate``
+  so a torn file can never produce garbage scales.
+- **int8 execution** runs the conv/matmul itself in int8:
+  activations are quantized per-ROW (per-sample absmax — a padded or
+  co-batched row can never perturb another row's scale, preserving
+  the engine's row-independence contract), the op runs through
+  ``lax.dot_general`` / ``lax.conv_general_dilated`` with
+  ``preferred_element_type=jnp.int32``, and the int32 accumulator is
+  rescaled once in f32 (``y * x_scale * w_scale``) before the bias.
+  On MXU-bearing accelerators int8 matmul runs at 2x bf16 peak; on
+  hosts without an int8 GEMM path (this CPU container) the win is
+  memory traffic only — see docs/QUANTIZATION.md for what the bench
+  gates where.
+- **bf16 mode** is weights-as-arguments at half the bytes: the float
+  leaves of the resident tree are cast to bf16 once at install and
+  the engine computes in bf16 (BN statistics stay f32 — the layer
+  library normalizes in f32 regardless).
+- The quantized tree is still a plain pytree of **executable
+  arguments** (int8 ``weight`` + f32 ``weight_scale`` per quantized
+  layer), so hot-swap stays an atomic pointer exchange and the whole
+  tree round-trips ``solver/snapshot.save_state`` bit-exactly (the
+  pack/unpack stability tests pin this across processes).
+
+Only ``Convolution`` and ``InnerProduct`` layers quantize (the two
+MXU ops); everything else — pooling, BN, LRN, softmax — runs the
+stock layer library at f32.  The engine folds the quant mode into
+``net_fingerprint`` so in-memory and persistent compile caches can
+never alias precisions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+QUANT_MODES = ("f32", "bf16", "int8")
+SCALE_KEY = "weight_scale"
+# layer types whose "weight" participates in an MXU matmul/conv with
+# the output channel on the LAST axis (the one per-channel rule)
+QUANTIZED_LAYER_TYPES = ("Convolution", "InnerProduct")
+
+
+def normalize_mode(quant: Any) -> str:
+    """None/""/f32 -> "f32"; validates everything else."""
+    if quant is None or quant == "":
+        return "f32"
+    mode = str(quant).lower()
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"quant mode {quant!r}: want one of {'/'.join(QUANT_MODES)}"
+        )
+    return mode
+
+
+# ------------------------------------------------------------- weight side
+def weight_scale(w) -> jnp.ndarray:
+    """Per-output-channel symmetric scale: absmax over every axis but
+    the last, /127.  All-zero channels get a floor instead of a 0/0
+    (their int8 weights are zero either way)."""
+    absmax = jnp.max(jnp.abs(jnp.asarray(w, jnp.float32)),
+                     axis=tuple(range(w.ndim - 1)))
+    return (jnp.maximum(absmax, 1e-12) / 127.0).astype(jnp.float32)
+
+
+def _quantizable(net, lname: str, leaf) -> bool:
+    """A layer's weight quantizes iff the layer is one of the two MXU
+    types and the weight has the matmul/conv rank (2=(in,out),
+    4=HWIO)."""
+    types = {l.name: l.type for l in net.layers}
+    return (
+        types.get(lname) in QUANTIZED_LAYER_TYPES
+        and getattr(leaf, "ndim", 0) in (2, 4)
+    )
+
+
+def capture_scales(net, params) -> Dict[str, np.ndarray]:
+    """layer name -> per-channel f32 scale vector, for every
+    quantizable weight in ``params`` (the audit/record view; the
+    quantized tree embeds the same values as ``weight_scale``
+    leaves)."""
+    out: Dict[str, np.ndarray] = {}
+    for lname, lp in params.items():
+        w = lp.get("weight") if isinstance(lp, dict) else None
+        if w is not None and _quantizable(net, lname, w):
+            out[lname] = np.asarray(weight_scale(w))
+    return out
+
+
+def quantize_tree(net, params) -> Dict[str, Any]:
+    """f32 param tree -> int8-packed tree: quantizable ``weight``
+    leaves become int8 with a sibling ``weight_scale`` f32 vector;
+    biases and non-MXU params ride through untouched (they are tiny
+    and the f32 bias add is free next to the int32 rescale)."""
+    q: Dict[str, Any] = {}
+    for lname, lp in params.items():
+        if not isinstance(lp, dict):
+            q[lname] = lp
+            continue
+        ql = dict(lp)
+        w = lp.get("weight")
+        if w is not None and _quantizable(net, lname, w):
+            scale = weight_scale(w)
+            ql["weight"] = jnp.clip(
+                jnp.round(jnp.asarray(w, jnp.float32) / scale),
+                -127, 127,
+            ).astype(jnp.int8)
+            ql[SCALE_KEY] = scale
+        q[lname] = ql
+    return q
+
+
+def dequantize_tree(qparams) -> Dict[str, Any]:
+    """int8 tree -> the f32 reconstruction (tests: the round-trip
+    error bound is one scale step per element)."""
+    out: Dict[str, Any] = {}
+    for lname, lp in qparams.items():
+        if not isinstance(lp, dict) or SCALE_KEY not in lp:
+            out[lname] = lp
+            continue
+        dl = {k: v for k, v in lp.items() if k != SCALE_KEY}
+        dl["weight"] = (
+            jnp.asarray(lp["weight"], jnp.float32) * lp[SCALE_KEY]
+        )
+        out[lname] = dl
+    return out
+
+
+def bf16_tree(tree):
+    """Cast float leaves to bf16 (ints — labels, int8 weights — keep
+    their dtype): the weights-as-arguments half-memory mode."""
+    def cast(leaf):
+        a = jnp.asarray(leaf)
+        return a.astype(jnp.bfloat16) if jnp.issubdtype(
+            a.dtype, jnp.floating
+        ) else a
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def tree_bytes(tree) -> int:
+    """Resident bytes of a param tree — the memory-traffic side of the
+    quantization record (int8 ≈ 1/4 of f32 + the scale vectors)."""
+    return int(sum(
+        np.asarray(a).size * np.asarray(a).dtype.itemsize
+        for a in jax.tree_util.tree_leaves(tree)
+    ))
+
+
+def quantize_snapshot(
+    net, target: str
+) -> Tuple[Dict[str, Any], Dict[str, Any], Optional[int]]:
+    """Capture scales + int8 weights from the newest *verified*
+    solverstate under ``target`` (prefix or file path) — the hot-swap
+    capture path, reusing the supervisor/watcher's manifest walk so a
+    torn newest file is skipped, never quantized.  Returns
+    ``(qparams, state, iter)``; raises when nothing intact exists."""
+    from ..solver.snapshot import load_state, newest_verified_solverstate
+
+    if target.endswith((".npz", ".orbax")):
+        it: Optional[int] = None
+        path = target
+    else:
+        got = newest_verified_solverstate(target)
+        if got is None:
+            raise FileNotFoundError(
+                f"no intact solverstate under {target!r}"
+            )
+        it, path = got
+    st = load_state(path)
+    return quantize_tree(net, st["params"]), st.get("state") or {}, it
+
+
+# --------------------------------------------------------- int8 execution
+def quantize_rows(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row (per-sample) symmetric activation quantization: absmax
+    over every axis but the batch axis.  Per-row (not per-tensor) so a
+    request's outputs never depend on its batch co-riders or the
+    engine's zero padding — the serving row-independence contract."""
+    axes = tuple(range(1, x.ndim))
+    absmax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _conv_int8(lp, p, x):
+    from ..nets.layers import _conv_geom
+
+    (kh, kw), (sh, sw), (ph, pw), (dh, dw), group, cout, bias = (
+        _conv_geom(lp)
+    )
+    xq, xs = quantize_rows(x.astype(jnp.float32))
+    y = lax.conv_general_dilated(
+        xq,
+        p["weight"],
+        window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=group,
+        preferred_element_type=jnp.int32,
+    )
+    # one f32 rescale of the int32 accumulator: x row scale broadcasts
+    # over (N,1,1,1), the per-channel weight scale over the last axis
+    y = y.astype(jnp.float32) * xs * p[SCALE_KEY]
+    if bias and "bias" in p:
+        y = y + p["bias"].astype(jnp.float32)
+    return y
+
+
+def _ip_int8(lp, p, x):
+    x2 = x.reshape(x.shape[0], -1).astype(jnp.float32)
+    xq, xs = quantize_rows(x2)
+    y = lax.dot_general(
+        xq, p["weight"], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = y.astype(jnp.float32) * xs * p[SCALE_KEY]
+    if "bias" in p:
+        y = y + p["bias"].astype(jnp.float32)
+    return y
+
+
+def apply_int8(net, qparams, state, batch):
+    """TEST-phase forward of ``net`` with an int8-packed tree: the
+    same layer walk as ``XLANet.apply`` but Convolution/InnerProduct
+    layers carrying a ``weight_scale`` execute in int8.  Everything
+    else (and any layer whose weight did not quantize) runs the stock
+    f32 implementation — quantization never changes which layers run,
+    only how the two MXU ops compute."""
+    from ..nets.layers import ApplyCtx, DATA_LAYER_TYPES, LAYER_IMPLS
+
+    ctx = ApplyCtx(train=False, rng=None, compute_dtype=jnp.float32)
+    blobs: Dict[str, jax.Array] = dict(batch)
+    for lp in net.layers:
+        if lp.type in DATA_LAYER_TYPES:
+            continue
+        p = qparams.get(lp.name, {})
+        inputs = [blobs[b] for b in lp.bottom]
+        if SCALE_KEY in p and lp.type == "Convolution":
+            outputs = [_conv_int8(lp, p, inputs[0])]
+        elif SCALE_KEY in p and lp.type == "InnerProduct":
+            outputs = [_ip_int8(lp, p, inputs[0])]
+        else:
+            outputs, _ = LAYER_IMPLS[lp.type].apply(
+                lp, p, state.get(lp.name), inputs, ctx
+            )
+        for top, out in zip(lp.top, outputs):
+            blobs[top] = out
+    return blobs, state

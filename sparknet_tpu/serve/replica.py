@@ -73,7 +73,16 @@ def add_engine_args(ap: argparse.ArgumentParser) -> None:
              "default) or fill (fill-then-flush, the A/B baseline)",
     )
     ap.add_argument("--top-k", type=int, default=5)
-    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--bf16", action="store_true",
+                    help="shorthand for --quant bf16 (kept for "
+                         "back-compat)")
+    ap.add_argument(
+        "--quant", choices=("f32", "bf16", "int8"), default=None,
+        help="quantized inference variant (serve/quantize.py): bf16 "
+             "weights-as-arguments, or per-channel int8 weights with "
+             "in-graph activation quantization; the compile caches "
+             "key the mode so precisions never alias",
+    )
     ap.add_argument(
         "--compile-cache", default=None, metavar="DIR",
         help="persistent compile cache root; executables land in "
@@ -114,6 +123,9 @@ def build_stack(args, *, watch_in_server: bool = True):
         from ..parallel import partition
 
         layout = partition.parse_layout(args.layout, rules="tp")
+    quant = getattr(args, "quant", None) or (
+        "bf16" if getattr(args, "bf16", False) else None
+    )
     metrics = ServeMetrics(args.buckets)
     engine = InferenceEngine.from_files(
         args.model,
@@ -122,6 +134,7 @@ def build_stack(args, *, watch_in_server: bool = True):
         compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         metrics=metrics,
         layout=layout,
+        quant=quant,
     )
     cache_info = None
     if args.compile_cache:
@@ -173,6 +186,7 @@ def write_portfile(path: str, server, engine, cache_info) -> None:
         "pid": os.getpid(),
         "warmup_s": getattr(engine, "warmup_s", None),
         "generation": getattr(engine, "generation", 0),
+        "quant": getattr(engine, "quant", "f32"),
         "compile_cache": cache_info,
     }
     tmp = path + ".tmp"

@@ -70,6 +70,7 @@ class Replica:
         self.outstanding = 0
         self.consecutive_fails = 0
         self.generation: Optional[int] = None
+        self.quant: Optional[str] = None
         self.warmup_s: Optional[float] = None
         self.weights_source: Optional[str] = None
         self.compile_cache: Optional[dict] = None
@@ -86,6 +87,7 @@ class Replica:
             "healthy": self.healthy,
             "outstanding": self.outstanding,
             "generation": self.generation,
+            "quant": self.quant,
             "warmup_s": self.warmup_s,
             "weights_source": self.weights_source,
             "compile_cache": self.compile_cache,
@@ -142,7 +144,16 @@ class Router:
     the replicas; the router's health loop drives its tick and
     discovers (re)spawned replicas' ports via their portfiles
     (``portfile_for(index, spawn)``).  ``watch``: snapshot prefix/dir
-    — a newer verified solverstate triggers a rolling reload."""
+    — a newer verified solverstate triggers a rolling reload.
+    ``quant_ab``: live quantization A/B — the fraction of /classify
+    traffic steered at replicas serving a **quantized** variant
+    (``quant != "f32"`` in their /healthz, the serve twin of the
+    ``gen`` tag).  Variant routing is a *preference*, never an
+    availability constraint: when the preferred variant has no
+    healthy replica (rolled back, ejected, still warming) the request
+    falls through to whoever is up, and per-variant answer counts are
+    recorded (``router_quant_answers{variant=}``) so the realized
+    split — including any fallback — is machine-checkable."""
 
     def __init__(
         self,
@@ -158,6 +169,7 @@ class Router:
         forward_timeout_s: float = 60.0,
         watch: Optional[str] = None,
         watch_interval_s: float = 2.0,
+        quant_ab: float = 0.0,
     ):
         from .. import chaos
 
@@ -181,6 +193,17 @@ class Router:
         self.forward_timeout_s = float(forward_timeout_s)
         self.metrics = RouterMetrics()
         self._chaos = chaos.get_plan()
+        self.quant_ab = float(quant_ab)
+        if not 0.0 <= self.quant_ab <= 1.0:
+            raise ValueError(
+                f"Router: quant_ab must be in [0, 1], got {quant_ab}"
+            )
+        # deterministic A/B assignment (Bresenham): request k prefers
+        # the quant variant iff floor((k+1)*frac) > floor(k*frac) —
+        # reproducible without an RNG, evenly INTERLEAVED (a 120-
+        # request burst at frac=0.5 splits 60/60, not 120/0 the way a
+        # `k mod 1000 < 500` window would)
+        self._ab = itertools.count()
         self._lock = threading.Lock()       # replica verdicts + counts
         self._rr = itertools.count()
         self._roll_lock = threading.Lock()  # one roll at a time
@@ -309,15 +332,28 @@ class Router:
             conn.close()
 
     # -------------------------------------------------------------- routing
-    def _pick(self, exclude: set) -> Optional[Replica]:
+    def _pick(
+        self, exclude: set, prefer_quant: Optional[bool] = None
+    ) -> Optional[Replica]:
         """Least-outstanding healthy replica not yet tried; ties break
-        round-robin so equal-load replicas share work."""
+        round-robin so equal-load replicas share work.
+        ``prefer_quant`` (the A/B draw): True narrows the pick to
+        quantized replicas, False to f32 ones — but only while the
+        preferred group has a healthy member; otherwise the full
+        ready set serves (availability beats split fidelity)."""
         with self._lock:
             ready = [
                 r for r in self.replicas
                 if r.healthy and r.port is not None
                 and r.index not in exclude
             ]
+            if prefer_quant is not None:
+                preferred = [
+                    r for r in ready
+                    if (r.quant not in (None, "f32")) == prefer_quant
+                ]
+                if preferred:
+                    ready = preferred
             if not ready:
                 return None
             low = min(r.outstanding for r in ready)
@@ -371,6 +407,15 @@ class Router:
         self.metrics.inc("requests")
         t0 = time.perf_counter()
         rctx = reqtrace.parse(trace_header) or reqtrace.mint()
+        # the A/B draw is per REQUEST, not per attempt: a retried
+        # request keeps its variant preference (and may still fall
+        # back to the other group when its own is down)
+        want_quant: Optional[bool] = None
+        if self.quant_ab > 0.0:
+            k = next(self._ab)
+            want_quant = (
+                int((k + 1) * self.quant_ab) > int(k * self.quant_ab)
+            )
         tried: set = set()
         last_err: Optional[str] = None
         # (replica index, reason) of the newest failed attempt — set
@@ -380,7 +425,7 @@ class Router:
         # short wait — a respawning replica (or a rolling swap) is a
         # latency blip, not an outage
         for attempt in range(2 * len(self.replicas) + 1):
-            rep = self._pick(tried)
+            rep = self._pick(tried, prefer_quant=want_quant)
             if rep is None:
                 if attempt and tried:
                     # every healthy peer tried and failed this pass:
@@ -447,6 +492,13 @@ class Router:
                 self.metrics.inc("retries")
                 continue
             hop.finish(outcome="ok", status=status, **hop_args)
+            if self.quant_ab > 0.0:
+                # the REALIZED split (fallbacks included): which
+                # variant actually answered, next to the request's gen
+                REGISTRY.counter(
+                    "router_quant_answers",
+                    variant=rep.quant or "f32",
+                ).inc()
             dt = time.perf_counter() - t0
             self._done(rep, dt)
             self.metrics.request_latency.observe(
@@ -490,6 +542,7 @@ class Router:
                     rep.healthy = True
                     self.metrics.inc("rejoins")
                 rep.generation = doc.get("generation")
+                rep.quant = doc.get("quant")
                 rep.warmup_s = doc.get("warmup_s")
                 rep.weights_source = doc.get("weights_source")
                 rep.compile_cache = doc.get("compile_cache")
@@ -648,7 +701,10 @@ class Router:
             reps = [r.snapshot() for r in self.replicas]
         healthy = sum(1 for r in reps if r["healthy"])
         gens = {r["generation"] for r in reps if r["healthy"]}
+        quants = {r["quant"] for r in reps if r["healthy"]}
         return {
+            "quant_ab": self.quant_ab,
+            "quants": sorted(q for q in quants if q is not None),
             "status": (
                 "ok" if healthy == len(reps)
                 else "degraded" if healthy else "down"

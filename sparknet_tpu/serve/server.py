@@ -181,6 +181,10 @@ class InferenceServer:
                         "generation": getattr(
                             outer.engine, "generation", 0
                         ),
+                        # active quantization mode next to gen: a
+                        # rolled-back (or mis-deployed) quant A/B is
+                        # machine-checkable from one health scrape
+                        "quant": getattr(outer.engine, "quant", "f32"),
                         "weights_source": getattr(
                             outer.engine, "weights_source", None
                         ),
@@ -405,6 +409,10 @@ class InferenceServer:
                     # (tests pin monotonicity), so clients and the
                     # router can see a rolling update propagate
                     "gen": getattr(outer.engine, "generation", 0),
+                    # which precision variant answered — the quant
+                    # A/B's per-response ground truth (loadgen records
+                    # the distinct set as served_quants)
+                    "quant": getattr(outer.engine, "quant", "f32"),
                 }
                 with reqtrace.span(
                     rhop.ctx if rhop is not None else None,

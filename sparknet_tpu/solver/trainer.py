@@ -269,6 +269,22 @@ class Solver:
         )
         self._eval_step = jax.jit(make_eval_step(self.test_net), **kw)
         self._scan_step_jits: Dict[int, Callable] = {}
+        # Audit-driven dispatch fusion (scripts/fusion_audit.py,
+        # BENCH_MODEL=fusion): the legacy loop issues two extra host
+        # dispatches per iteration — ``jax.random.split`` as its own
+        # compiled program, and a scalar device_put for the iteration
+        # counter.  The fused step folds both into the one compiled
+        # program (split is a deterministic function, so the rng
+        # stream — and therefore the trained weights — stays BITWISE
+        # identical; pinned by tests/test_fusion.py) and carries the
+        # counter on device.  ``SPARKNET_FUSED_STEP=0`` keeps the
+        # legacy shape reachable as the bench A/B baseline; the
+        # parallel step builders opt out (they own their dispatch).
+        self._fuse_host = os.environ.get(
+            "SPARKNET_FUSED_STEP", "1"
+        ) not in ("", "0")
+        self._fused_step: Optional[Callable] = None
+        self._it_dev = None
 
     def step(self, batches: Iterator[Dict[str, Any]], n: int = 1, log_fn=None):
         """Run ``n`` iterations (the reference's ``Solver::Step(n)``).
@@ -300,17 +316,28 @@ class Solver:
             with tl.phase("device_put"):
                 batch = self._put_batch(batch)
             with tl.phase("compiled_step"):
-                self.rng, step_rng = jax.random.split(self.rng)
-                self.params, self.state, self.opt_state, metrics = (
-                    self._train_step(
-                        self.params,
-                        self.state,
-                        self.opt_state,
-                        batch,
-                        jnp.asarray(self.iter, jnp.int32),
-                        step_rng,
+                if self._fuse_host:
+                    if self._it_dev is None:
+                        self._it_dev = jnp.asarray(self.iter, jnp.int32)
+                    (
+                        self.params, self.state, self.opt_state,
+                        self._it_dev, self.rng, metrics,
+                    ) = self._ensure_fused_step()(
+                        self.params, self.state, self.opt_state,
+                        batch, self._it_dev, self.rng,
                     )
-                )
+                else:
+                    self.rng, step_rng = jax.random.split(self.rng)
+                    self.params, self.state, self.opt_state, metrics = (
+                        self._train_step(
+                            self.params,
+                            self.state,
+                            self.opt_state,
+                            batch,
+                            jnp.asarray(self.iter, jnp.int32),
+                            step_rng,
+                        )
+                    )
                 if tl.fence:
                     jax.block_until_ready(metrics)
             self.iter += 1
@@ -319,6 +346,28 @@ class Solver:
                 if self.iter % self.sp.display == 0:
                     log_fn(self.iter, self._smoothed(metrics))
         return metrics
+
+    def _ensure_fused_step(self) -> Callable:
+        """The fused one-dispatch-per-iteration program, compiled
+        lazily: the base train step plus the per-iteration host work
+        (rng split, counter increment) inside the same XLA program.
+        The rng key and counter are donated — both are replaced every
+        call."""
+        if self._fused_step is None:
+            fn = self._train_step_fn
+
+            def fused(params, state, opt_state, batch, it, rng):
+                rng, step_rng = jax.random.split(rng)
+                params, state, opt_state, metrics = fn(
+                    params, state, opt_state, batch, it, step_rng
+                )
+                return params, state, opt_state, it + 1, rng, metrics
+
+            self._fused_step = jax.jit(
+                fused, donate_argnums=(0, 1, 2, 4, 5),
+                **step_compile_kw(),
+            )
+        return self._fused_step
 
     def scan_steps(self, batch, n: int):
         """Run ``n`` train iterations on ONE resident batch inside a
@@ -370,6 +419,7 @@ class Solver:
             jnp.asarray(self.iter, jnp.int32), scan_rng,
         )
         self.iter += n
+        self._it_dev = None  # scan advanced iter outside the fused step
         return metrics
 
     def _push_loss(self, metrics) -> None:
@@ -434,6 +484,7 @@ class Solver:
                 if msg:
                     print(f"WARNING: {msg}", file=sys.stderr, flush=True)
         self.iter = int(st["it"])
+        self._it_dev = None  # re-seed the fused step's device counter
         self.rng = jnp.asarray(st["rng"])
         self._loss_window.clear()  # a restarted Caffe starts empty
         if weights_only:

@@ -192,7 +192,9 @@ def _share_table(shares, phases) -> str:
 def _router_section(router: dict) -> str:
     """Serving-tier tiles + per-replica table (router /dash only):
     healthy count, served generations, retry/respawn counters, and one
-    row per replica — state, outstanding, generation, p50/p99."""
+    row per replica — state, outstanding, generation, precision
+    (quant variant — a rolled-back A/B reads straight off the table),
+    p50/p99."""
     rm = router.get("router") or {}
     lat = rm.get("request_latency") or {}
     healthy = router.get("replicas_healthy", 0)
@@ -225,13 +227,15 @@ def _router_section(router: dict) -> str:
             f'<td>{_esc(r.get("addr") or "?")}</td>'
             f'<td>{r.get("outstanding", 0)}</td>'
             f'<td>{_esc(r.get("generation"))}</td>'
+            f'<td>{_esc(r.get("quant") or "f32")}</td>'
             f'<td>{r.get("forwarded", 0)}</td>'
             f'<td>{fmt(rl.get("p50_ms"))}</td>'
             f'<td>{fmt(rl.get("p99_ms"))}</td></tr>'
         )
     table = (
         '<table class="data"><thead><tr><th>replica</th><th>state</th>'
-        "<th>addr</th><th>outstanding</th><th>gen</th><th>forwarded</th>"
+        "<th>addr</th><th>outstanding</th><th>gen</th>"
+        "<th>precision</th><th>forwarded</th>"
         "<th>p50 ms</th><th>p99 ms</th></tr></thead>"
         f'<tbody>{"".join(rows)}</tbody></table>'
     )

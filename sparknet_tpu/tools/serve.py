@@ -59,6 +59,13 @@ def main(argv=None):
              "(0: single process)",
     )
     ap.add_argument(
+        "--quant-ab", type=float, default=0.0, metavar="FRAC",
+        help="router mode: live quantization A/B — odd-indexed "
+             "replicas serve the --quant variant, even stay f32, and "
+             "FRAC of /classify traffic prefers the quantized group "
+             "(docs/QUANTIZATION.md)",
+    )
+    ap.add_argument(
         "--run-dir", default=None,
         help="router mode: where portfiles/logs land (default: a "
              "temp dir)",
@@ -85,6 +92,14 @@ def main(argv=None):
         help="request row-counts the load generator cycles through",
     )
     args = ap.parse_args(argv)
+
+    if args.quant_ab:
+        if not (args.quant and args.quant != "f32"):
+            ap.error("--quant-ab needs --quant bf16|int8 (the variant "
+                     "the A/B fraction steers to)")
+        if args.replicas < 2:
+            ap.error("--quant-ab needs --replicas >= 2 (at least one "
+                     "replica per variant)")
 
     if args.replicas > 0:
         return _run_router(args)
@@ -139,6 +154,17 @@ def _replica_argv(args, run_dir: str, index: int, spawn: int):
         argv += ["--weights", args.weights]
     if args.bf16:
         argv.append("--bf16")
+    # quantization A/B: odd-indexed replicas serve the quant variant,
+    # even-indexed stay f32 — the router's health scrape learns each
+    # side's mode and --quant-ab steers the split.  Without --quant-ab
+    # every replica serves --quant uniformly.
+    quant = getattr(args, "quant", None)
+    if quant and quant != "f32":
+        if getattr(args, "quant_ab", 0.0) > 0.0:
+            if index % 2 == 1:
+                argv += ["--quant", quant]
+        else:
+            argv += ["--quant", quant]
     if args.compile_cache:
         argv += ["--compile-cache", args.compile_cache]
     if args.data_cache:
@@ -175,6 +201,7 @@ def _run_router(args):
         model_name=os.path.basename(args.model),
         health_interval_s=args.health_interval_s,
         watch=args.snapshot_watch,
+        quant_ab=getattr(args, "quant_ab", 0.0),
     )
     pool.start()
     router.start()

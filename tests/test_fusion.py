@@ -1,0 +1,211 @@
+"""Dispatch fusion (ISSUE 12): the fused train step — rng split +
+iteration counter folded into the compiled program — must be BITWISE
+identical to the legacy three-dispatch loop, survive restore, stay
+out of the parallel solvers' way, and the trace-driven audit
+(scripts/fusion_audit.py) must find the gaps that ground it."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.solver.trainer import Solver
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+AUDIT = os.path.join(REPO, "scripts", "fusion_audit.py")
+
+TINY_NET = """
+name: "tiny"
+layer { name: "d" type: "Input" top: "data" top: "label" }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss" }
+"""
+SOLVER_TXT = "base_lr: 0.1 momentum: 0.9 lr_policy: 'fixed' weight_decay: 0.001"
+SHAPES = {"data": (8, 8), "label": (8,)}
+
+
+def make_solver(seed=7):
+    return Solver(
+        caffe_pb.load_solver(SOLVER_TXT, is_path=False), SHAPES,
+        net_param=caffe_pb.load_net(TINY_NET, is_path=False), seed=seed,
+    )
+
+
+def feed():
+    rng = np.random.default_rng(11)
+    while True:
+        yield {
+            "data": rng.normal(size=(8, 8)).astype(np.float32),
+            "label": rng.integers(0, 4, size=(8,)).astype(np.int32),
+        }
+
+
+def leaves(params):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(params)
+    )]
+
+
+def test_fused_step_bitwise_equals_legacy():
+    """jax.random.split is the same deterministic function inside and
+    outside jit: folding it (and the counter) into the step changes
+    dispatch count, never the rng stream or the weights."""
+    legacy = make_solver()
+    legacy._fuse_host = False
+    fused = make_solver()
+    fused._fuse_host = True
+    legacy.step(feed(), 6)
+    fused.step(feed(), 6)
+    assert legacy.iter == fused.iter == 6
+    for a, b in zip(leaves(legacy.params), leaves(fused.params)):
+        np.testing.assert_array_equal(a, b)
+    # the rng key itself advanced identically
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(legacy.rng)),
+        np.asarray(jax.device_get(fused.rng)),
+    )
+
+
+def test_fused_step_is_the_default_and_env_disables(monkeypatch):
+    assert make_solver()._fuse_host is True
+    monkeypatch.setenv("SPARKNET_FUSED_STEP", "0")
+    assert make_solver()._fuse_host is False
+
+
+def test_fused_resume_reseeds_device_counter(tmp_path):
+    """restore() must invalidate the on-device iteration counter, so
+    an interrupted fused run resumes bit-identically to the
+    uninterrupted one (LR schedules read the counter)."""
+    base = make_solver()
+    base._fuse_host = True
+    base.step(feed(), 8)
+
+    first = make_solver()
+    first._fuse_host = True
+    f = feed()
+    first.step(f, 4)
+    path = str(tmp_path / "mid_iter_4.solverstate.npz")
+    first.save(path)
+
+    resumed = make_solver()
+    resumed._fuse_host = True
+    resumed.step(feed(), 2)  # park the counter somewhere wrong
+    resumed.restore(path)
+    assert resumed._it_dev is None
+    resumed.align_feed(g := feed())
+    resumed.step(g, 4)
+    assert resumed.iter == 8
+    for a, b in zip(leaves(base.params), leaves(resumed.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_parallel_solver_opts_out_of_fusion():
+    from sparknet_tpu.parallel import ParallelSolver, make_mesh
+
+    par = ParallelSolver(
+        caffe_pb.load_solver(SOLVER_TXT, is_path=False), SHAPES,
+        net_param=caffe_pb.load_net(TINY_NET, is_path=False), seed=7,
+        mesh=make_mesh(), mode="sync",
+    )
+    assert par._fuse_host is False
+
+
+# ------------------------------------------------------------ fusion audit
+def synth_trace(gap_us=0.0, iters=5, put_us=50.0):
+    """A timeline-shaped Chrome trace: input_wait -> device_put ->
+    compiled_step per iteration, with ``gap_us`` of unattributed host
+    time inserted before each compiled_step."""
+    evs = []
+    ts = 1000.0
+    for _ in range(iters):
+        for name, dur in (
+            ("input_wait", 100.0),
+            ("device_put", put_us),
+            ("compiled_step", 800.0),
+        ):
+            if name == "compiled_step":
+                ts += gap_us
+            evs.append({"name": name, "ph": "X", "ts": ts, "dur": dur,
+                        "pid": 1, "tid": 1, "cat": "timeline"})
+            ts += dur
+    return {"traceEvents": evs}
+
+
+def run_audit(tmp_path, doc, *args):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(doc))
+    return subprocess.run(
+        [sys.executable, AUDIT, str(p), *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_audit_finds_dispatch_gap(tmp_path):
+    r = run_audit(tmp_path, synth_trace(gap_us=300.0), "--json",
+                  "--informational")
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    kinds = [f["kind"] for f in rec["findings"]]
+    assert "dispatch_gap" in kinds
+    assert rec["iterations"] == 5
+    # the gap aggregates on the transition where it was inserted
+    top = next(iter(rec["transitions"]))
+    assert top == "device_put -> compiled_step"
+    # gating mode: findings exit 1 without --informational
+    assert run_audit(tmp_path, synth_trace(gap_us=300.0)).returncode == 1
+
+
+def test_audit_clean_trace_has_no_findings(tmp_path):
+    r = run_audit(tmp_path, synth_trace(gap_us=0.0), "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    gating = [f for f in rec["findings"]
+              if not f.get("informational")]
+    assert gating == []
+
+
+def test_audit_flags_device_put_stalls(tmp_path):
+    doc = synth_trace(gap_us=0.0, put_us=900.0)
+    r = run_audit(tmp_path, doc, "--json", "--informational")
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "device_put_stall" in [f["kind"] for f in rec["findings"]]
+
+
+def test_audit_reads_a_real_solver_trace(tmp_path):
+    """End to end: a traced legacy run's capture parses, attributes
+    the timeline phases, and counts the iterations."""
+    from sparknet_tpu.telemetry import timeline as ttl
+    from sparknet_tpu.telemetry import trace as tr
+
+    path = str(tmp_path / "real.json")
+    s = make_solver()
+    s._fuse_host = False
+    tr.enable(path)
+    try:
+        tl = ttl.Timeline(fence=True)
+        s.timeline = tl
+        tl.start()
+        s.step(feed(), 5)
+        tl.stop()
+        tr.write(path)
+    finally:
+        tr.disable()
+    r = subprocess.run(
+        [sys.executable, AUDIT, path, "--json", "--informational"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["iterations"] == 5
+    assert "compiled_step" in rec["phases"]
+    assert "perf_counter" not in open(AUDIT).read()
