@@ -52,6 +52,16 @@ Env knobs:
                              ms, interleaved rounds, plus the
                              scripts/fusion_audit.py record of a
                              traced legacy run
+  BENCH_MODEL=session_serving session-aware serving A/B (ISSUE 13):
+                             per-request latency of a session step
+                             served from the decode-state cache vs the
+                             cold full-prefix replay on the char-rnn
+                             decoder (same compiled step — answers
+                             bit-identical, gate >=5x), plus a
+                             2-replica tier under Zipf hot-session
+                             load with a mid-session holder SIGKILL
+                             (zero failed requests + counted
+                             migrations is the bar)
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
   BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
@@ -944,6 +954,197 @@ def bench_serving_tier(platform: str) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_session_serving(platform: str) -> dict:
+    """Session-aware serving A/B (``BENCH_MODEL=session_serving``,
+    ISSUE 13).
+
+    Three measurements, one record:
+
+    1. **Cached vs cold per-request latency** (in-process, the
+       char-rnn decoder): a session step served from the decode-state
+       cache processes O(new tokens); a cold request replays the full
+       prefix through the SAME compiled step.  Interleaved cold/hot
+       rounds, median of per-round ratios (the 1-CPU discipline from
+       the reqtrace-overhead arm) — ``cached_speedup``, gated >=5x by
+       ``bench_diff``.
+    2. **Equal correctness**: the hit-path answer for a prefix is
+       bit-compared against the cold-path answer — same executable, so
+       bitwise equality is structural, and the record says so
+       (``bit_identical``).
+    3. **Chaos e2e** (subprocess): a 2-replica router tier takes Zipf
+       hot-session ``/generate`` traffic while the replica holding the
+       hottest sessions is SIGKILLed mid-run — zero failed requests,
+       cache hits observed, and every migration counted
+       (``session_failed_requests`` / ``tier.migrations``)."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from sparknet_tpu.serve.engine import InferenceEngine
+    from sparknet_tpu.serve.loadgen import run_http_loadgen
+    from sparknet_tpu.serve.server import Client
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    deploy = os.path.join(zoo, "char_rnn_deploy.prototxt")
+    prefix_len = int(os.environ.get("BENCH_SESSION_PREFIX", 48))
+    reqs = int(os.environ.get("BENCH_SESSION_REQUESTS", 20))
+
+    engine = InferenceEngine.from_files(deploy)
+    engine.warmup()
+    rng = np.random.default_rng(0)
+    prefix = [int(t) for t in rng.integers(0, 96, size=prefix_len)]
+
+    # ---- arm 2 first (cheap): bit-identity hit-vs-cold
+    engine.generate(prefix, session="bit", steps=0)
+    hit = engine.generate(prefix + [7], session="bit", steps=0)
+    cold = engine.generate(prefix + [7], steps=0)
+    bit_identical = (
+        hit["cache_state"] == "hit"
+        and hit["probs"] == cold["probs"]
+        and hit["indices"] == cold["indices"]
+    )
+
+    # ---- arm 1: interleaved cold/hot rounds, median per-round ratio
+    rounds = []
+    hist = list(prefix)
+    engine.generate(hist, session="hot")  # populate
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(reqs):
+            engine.generate(prefix, session=f"cold-{i}")
+            engine.session_cache.drop(engine.fingerprint, f"cold-{i}")
+        cold_ms = (time.perf_counter() - t0) / reqs * 1e3
+        t0 = time.perf_counter()
+        for i in range(reqs):
+            hist.append(i % 96)
+            out = engine.generate(hist, session="hot")
+            assert out["cache_state"] == "hit", out["cache_state"]
+        hot_ms = (time.perf_counter() - t0) / reqs * 1e3
+        rounds.append({
+            "cold_ms": round(cold_ms, 3),
+            "cached_ms": round(hot_ms, 3),
+            "speedup": round(cold_ms / hot_ms, 2),
+        })
+    speedups = sorted(r["speedup"] for r in rounds)
+    cached_speedup = speedups[len(speedups) // 2]
+
+    # ---- arm 3: the tier under Zipf session load + holder kill
+    tmp = tempfile.mkdtemp(prefix="bench_session_serving_")
+    proc = None
+    try:
+        from sparknet_tpu.solver import snapshot as snap
+
+        weights0 = os.path.join(tmp, "w_iter_10.solverstate.npz")
+        snap.save_state(
+            weights0,
+            params=jax.device_get(engine.params),
+            state=jax.device_get(engine.state),
+        )
+        portfile = os.path.join(tmp, "router.json")
+        child_env = dict(os.environ)
+        if platform == "cpu":
+            child_env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sparknet_tpu.tools.serve",
+             "--model", deploy, "--weights", weights0,
+             "--replicas", "2", "--port", "0", "--buckets", "1",
+             "--portfile", portfile,
+             "--run-dir", os.path.join(tmp, "run")],
+            cwd=_HERE, env=child_env,
+        )
+        deadline = time.time() + 600
+        while not os.path.exists(portfile):
+            if proc.poll() is not None or time.time() > deadline:
+                raise RuntimeError("session tier failed to start")
+            time.sleep(0.2)
+        doc = json.load(open(portfile))
+        client = Client(doc["host"], doc["port"], timeout=60, retries=4)
+        while True:
+            try:
+                _, hz = client.healthz()
+                if hz.get("replicas_healthy") == 2:
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError("replicas never became healthy")
+            time.sleep(0.3)
+
+        result = {}
+
+        def drive():
+            result["lg"] = run_http_loadgen(
+                doc["host"], doc["port"], (),
+                n_requests=int(
+                    os.environ.get("BENCH_SESSION_TIER_REQUESTS", 240)
+                ),
+                concurrency=3, sessions=6, session_zipf=1.2,
+            )
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        # kill whichever replica holds sessions MID-burst (wait for the
+        # router's scrape to show resident state, then strike): the
+        # affinity-then-eject migration scenario
+        victim = None
+        kill_deadline = time.time() + 60
+        while time.time() < kill_deadline and t.is_alive():
+            _, hz = client.healthz()
+            holders = [
+                r for r in hz["replicas"]
+                if (r.get("session_cache") or {}).get("entries", 0) > 0
+            ]
+            if holders:
+                victim = holders[0]["pid"]
+                break
+            time.sleep(0.2)
+        if victim is not None:
+            os.kill(victim, signal.SIGKILL)
+        t.join(600)
+        lg = result.get("lg") or {}
+        _, tier_metrics = client.metrics()
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        proc = None
+        router_m = (tier_metrics or {}).get("router") or {}
+
+        return {
+            "metric": "session_serving_cached_speedup",
+            "value": cached_speedup,
+            "unit": "x",
+            "vs_baseline": None,
+            "platform": platform,
+            "prefix_tokens": prefix_len,
+            "requests_per_round": reqs,
+            "rounds": rounds,
+            "cold_ms": rounds[-1]["cold_ms"],
+            "cached_ms": rounds[-1]["cached_ms"],
+            "cached_speedup": cached_speedup,
+            "bit_identical": bit_identical,
+            "session_cache": engine.session_cache.snapshot(),
+            "session_failed_requests": lg.get(
+                "session_failed_requests"
+            ),
+            "tier": {
+                "replicas": 2,
+                "loadgen": lg,
+                "sessions": lg.get("sessions"),
+                "migrations": router_m.get("session_migrations"),
+                "failed_requests": lg.get("failed_requests"),
+            },
+            "host_cpus": os.cpu_count(),
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_quant_serving(platform: str) -> dict:
     """Quantized-inference A/B (``BENCH_MODEL=quant_serving``, ISSUE 12).
 
@@ -1573,6 +1774,8 @@ def main() -> None:
         runner = bench_serving_tier
     elif mode == "quant_serving":
         runner = bench_quant_serving
+    elif mode == "session_serving":
+        runner = bench_session_serving
     elif mode == "fusion":
         runner = bench_fusion
     elif mode in IMAGENET_ARCHS:
@@ -1583,7 +1786,8 @@ def main() -> None:
         raise ValueError(
             f"BENCH_MODEL={mode!r}: want "
             f"bert|input_pipeline|data_plane|comm|sharding|serving_tier|"
-            f"quant_serving|fusion|{'|'.join(IMAGENET_ARCHS)}"
+            f"quant_serving|session_serving|fusion|"
+            f"{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
         with jax.profiler.trace(profile_dir):
@@ -1630,6 +1834,8 @@ if __name__ == "__main__":
                         if mode == "serving_tier"
                         else "quant_serving_int8_speedup"
                         if mode == "quant_serving"
+                        else "session_serving_cached_speedup"
+                        if mode == "session_serving"
                         else "fusion_step_ms_fused"
                         if mode == "fusion"
                         else f"{mode}_train_images_per_sec_per_chip"

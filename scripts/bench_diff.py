@@ -217,6 +217,35 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
         bad = b <= 1.0
         add("fusion_speedup", old.get("fusion_speedup"), b, "", bad,
             "audit fix must cut step_ms" if bad else "ok")
+    # session-serving records (BENCH_MODEL=session_serving, ISSUE 13):
+    # the cached path must beat the cold full-prefix replay by the
+    # ABSOLUTE floor (>=5x by default — an O(1) step vs an O(prefix)
+    # rebuild should not be close), at equal correctness (hit-vs-cold
+    # answers bitwise equal), with zero failed session requests during
+    # the chaos arm (a killed holder costs a migration, never an
+    # answer)
+    if str(new.get("metric", "")).startswith("session_serving"):
+        b = new.get("cached_speedup")
+        if b is not None:
+            low = b < args.session_speedup_min
+            add("session_cached_speedup", old.get("cached_speedup"), b,
+                "", low,
+                f"≥{args.session_speedup_min:g}x is the bar"
+                if low else "ok")
+        bi = new.get("bit_identical")
+        if bi is not None:
+            add("session_bit_identical", None, float(bool(bi)), "",
+                not bi,
+                "ok" if bi else "hit-vs-cold answers DIFFER")
+    b = find_key(new, "session_failed_requests")
+    if b is not None:
+        a = find_key(old, "session_failed_requests")
+        add("session_failed_requests", a, b, "", bool(b),
+            "ZERO is the bar" if b else "ok")
+    b = find_key(new, "session_migrations")
+    if b is not None:
+        a = find_key(old, "session_migrations")
+        add("session_migrations", a, b, "", False, "informational")
     # served-generation coverage (hot-swap observability): count of
     # distinct generations answered during the run — informational
     gens_old = (old.get("tier") or {}).get("served_generations")
@@ -277,6 +306,10 @@ def main(argv=None) -> int:
     ap.add_argument("--int8-bytes-x", type=float, default=1.5,
                     help="int8 resident-weight-bytes compression "
                          "floor vs f32, x (default 1.5)")
+    ap.add_argument("--session-speedup-min", type=float, default=5.0,
+                    help="session-cache cached-vs-cold per-request "
+                         "latency floor, x (session_serving records; "
+                         "default 5)")
     ap.add_argument("--informational", action="store_true",
                     help="print the table but always exit 0 (the "
                          "check.sh mode)")

@@ -355,6 +355,19 @@ else
   exit 1
 fi
 
+# ---- session smoke (ISSUE 13): a 1-router/2-replica tier on the
+# char-rnn decoder runs a 3-step /generate session with a SIGKILL of
+# the state-holding replica mid-session — step 2 must hit the session
+# cache, the post-kill step must answer migrated+cold with the
+# migration counted, and the final answers must equal a fresh
+# cold-path request bitwise (rebuilt, never wrong).
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/session_smoke.py; then
+  echo "check.sh: session smoke OK (affinity hit + holder kill -> counted migration, answers == cold path)"
+else
+  echo "check.sh: session SMOKE FAILED"
+  exit 1
+fi
+
 # ---- quant smoke (ISSUE 12): an int8 1-replica tier hot-swaps a
 # manifest-verified snapshot (scales re-captured at swap time), the
 # quant tag rides /healthz and /classify next to gen, f32-vs-int8

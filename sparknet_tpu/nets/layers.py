@@ -416,18 +416,29 @@ class InnerProduct:
         return int(p.get("num_output")), bool(p.get("bias_term", True)), int(p.get("axis", 1))
 
     @staticmethod
-    def infer(lp, in_shapes):
-        cout, _, axis = InnerProduct._geom(lp)
-        if axis != 1:
-            raise NotImplementedError(
-                f"layer {lp.name!r}: inner_product axis={axis} unsupported (only 1)"
+    def _axis(lp, ndim: int) -> int:
+        # Caffe semantics: dims before `axis` are preserved (batch-like),
+        # dims from `axis` on are flattened into the contraction
+        ax = InnerProduct._geom(lp)[2]
+        ax = ax if ax >= 0 else ndim + ax
+        if not 1 <= ax < ndim:
+            raise ValueError(
+                f"layer {lp.name!r}: inner_product axis={ax} out of "
+                f"range for a {ndim}-d bottom"
             )
-        return [(in_shapes[0][0], cout)]
+        return ax
+
+    @staticmethod
+    def infer(lp, in_shapes):
+        cout, _, _ = InnerProduct._geom(lp)
+        ax = InnerProduct._axis(lp, len(in_shapes[0]))
+        return [tuple(in_shapes[0][:ax]) + (cout,)]
 
     @staticmethod
     def init(lp, rng, in_shapes):
-        cout, bias, axis = InnerProduct._geom(lp)
-        cin = int(np.prod(in_shapes[0][1:]))
+        cout, bias, _ = InnerProduct._geom(lp)
+        ax = InnerProduct._axis(lp, len(in_shapes[0]))
+        cin = int(np.prod(in_shapes[0][ax:]))
         p = lp.inner_product_param
         wf = Filler.from_message(p.get("weight_filler"))
         bf = Filler.from_message(p.get("bias_filler"))
@@ -439,9 +450,11 @@ class InnerProduct:
 
     @staticmethod
     def apply(lp, params, state, inputs, ctx):
-        cout, bias, axis = InnerProduct._geom(lp)
+        cout, bias, _ = InnerProduct._geom(lp)
         x = inputs[0]
-        x2 = x.reshape(x.shape[0], -1).astype(ctx.compute_dtype)
+        ax = InnerProduct._axis(lp, x.ndim)
+        lead = x.shape[:ax]
+        x2 = x.reshape(int(np.prod(lead)), -1).astype(ctx.compute_dtype)
         w = params["weight"].astype(ctx.compute_dtype)
         # mxu_dot: f32 accumulation forward AND compute-dtype
         # backward dots (the default transpose rule would run the
@@ -449,7 +462,7 @@ class InnerProduct:
         y = mxu_dot(x2, w)
         if bias and "bias" in params:
             y = y + params["bias"]
-        return [y.astype(ctx.compute_dtype)], None
+        return [y.astype(ctx.compute_dtype).reshape(lead + (cout,))], None
 
 
 class ReLU:

@@ -36,6 +36,15 @@ engine behind a batched request queue:
   time, int8 matmul/conv with f32 rescale, precision-keyed compile
   caches, and the router's live ``--quant-ab`` A/B
   (docs/QUANTIZATION.md).
+- :mod:`~sparknet_tpu.serve.session` — session-aware serving (ISSUE
+  13): a recurrent net's decode step compiled once with the carried
+  state as a donated executable argument
+  (:class:`~sparknet_tpu.serve.session.DecodeStepper`), the
+  LRU-by-hit, generation-tagged per-session state cache
+  (:class:`~sparknet_tpu.serve.session.SessionCache`), the engine's
+  ``generate`` entry point (``POST /generate``) and the router's
+  session-affinity dispatch with counted migrations
+  (docs/SERVING.md "Sessions").
 
 See docs/SERVING.md for the architecture and knob reference.
 """
@@ -46,18 +55,21 @@ from .loadgen import run_http_loadgen, run_loadgen
 from .metrics import Counter, LatencyHistogram, ServeMetrics
 from .router import Router
 from .server import Client, InferenceServer
+from .session import DecodeStepper, SessionCache
 
 __all__ = [
     "Backpressure",
     "Client",
     "Counter",
     "DeadlineExceeded",
+    "DecodeStepper",
     "InferenceEngine",
     "InferenceServer",
     "LatencyHistogram",
     "MicroBatcher",
     "Router",
     "ServeMetrics",
+    "SessionCache",
     "run_http_loadgen",
     "run_loadgen",
 ]

@@ -77,12 +77,18 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("rows", "n", "future", "t_enq", "deadline", "ctx")
+    __slots__ = ("rows", "n", "future", "t_enq", "deadline", "ctx", "fn")
 
-    def __init__(self, rows: np.ndarray, deadline_s: Optional[float] = None,
-                 ctx=None):
+    def __init__(self, rows: Optional[np.ndarray],
+                 deadline_s: Optional[float] = None,
+                 ctx=None, fn=None):
+        # either a rows request (coalescable into engine batches) or a
+        # callable request (``submit_call`` — e.g. a session decode):
+        # both share the queue, the FIFO order, backpressure, and the
+        # deadline-shed machinery
         self.rows = rows
-        self.n = len(rows)
+        self.fn = fn
+        self.n = 1 if rows is None else len(rows)
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
         self.deadline = (
@@ -178,6 +184,38 @@ class MicroBatcher:
             ) from None
         if self.mode == "continuous":
             self._note_arrival(item)
+        if self.metrics is not None:
+            self.metrics.set_queue_depth(self._q.qsize())
+        return item.future
+
+    def submit_call(
+        self,
+        fn,
+        *,
+        block: bool = False,
+        timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        ctx=None,
+    ) -> Future:
+        """Enqueue one callable request (a session ``generate``): it
+        runs **in queue position** on the single worker thread, so
+        stateful decode and batched classify share one serialized
+        engine feed, one backpressure bound and one deadline-shed path
+        — a generate can never race a classify onto the device, and an
+        expired generate is shed before compute exactly like rows."""
+        if not self._open:
+            raise RuntimeError("MicroBatcher is drained/closed")
+        item = _Pending(
+            None,
+            self.deadline_s if deadline_s is None else deadline_s,
+            ctx, fn=fn,
+        )
+        try:
+            self._q.put(item, block=block, timeout=timeout)
+        except queue.Full:
+            raise Backpressure(
+                f"request queue full ({self._q.maxsize} pending)"
+            ) from None
         if self.metrics is not None:
             self.metrics.set_queue_depth(self._q.qsize())
         return item.future
@@ -360,6 +398,57 @@ class MicroBatcher:
                     it.ctx, "batcher.wait", it.t_enq,
                     rows=it.n, mode=self.mode,
                 )
+        # callable requests (submit_call — session decode) run in queue
+        # position: split the batch into maximal rows runs and calls,
+        # preserving FIFO — a rows run coalesces into one engine batch
+        # exactly as before, a call runs alone
+        if any(it.fn is not None for it in batch):
+            i = 0
+            while i < len(batch):
+                if batch[i].fn is not None:
+                    self._run_call(batch[i])
+                    i += 1
+                else:
+                    j = i
+                    while j < len(batch) and batch[j].fn is None:
+                        j += 1
+                    self._run_rows(
+                        batch[i:j], sum(it.n for it in batch[i:j])
+                    )
+                    i = j
+            return
+        self._run_rows(batch, sum(it.n for it in batch))
+
+    def _run_call(self, it: _Pending) -> None:
+        t0 = time.perf_counter()
+        try:
+            out = it.fn()
+        except Exception as e:
+            if self.metrics is not None:
+                self.metrics.record_error()
+            if not it.future.cancelled():
+                it.future.set_exception(e)
+            return
+        now = time.perf_counter()
+        if it.ctx is not None:
+            # the decode's slot on the stitched waterfall (recorded
+            # before the future resolves, like engine.compute)
+            _reqtrace.record_interval(
+                it.ctx, "engine.generate", t0, now,
+            )
+        if not it.future.cancelled():
+            it.future.set_result(out)
+        if self.metrics is not None:
+            lat = now - it.t_enq
+            self.metrics.record_request(
+                lat, rows=it.n,
+                exemplar=(
+                    (it.ctx.trace_id, lat)
+                    if it.ctx is not None and it.ctx.sampled else None
+                ),
+            )
+
+    def _run_rows(self, batch: List[_Pending], total: int) -> None:
         t0 = time.perf_counter()
         try:
             with _trace.span("serve.flush", cat="serve",

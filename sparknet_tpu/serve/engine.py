@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from ..telemetry import trace as _trace
 from . import quantize as _quantize
+from . import session as _session
 from .compile_cache import net_fingerprint
 
 Rows = Union[np.ndarray, Dict[str, np.ndarray]]
@@ -116,6 +117,12 @@ class InferenceEngine:
                 "layout is not supported (quantize the replicated "
                 "serving shape; layouts keep f32/bf16)"
             )
+        if self.quant == "int8" and _session.DecodeStepper.supports(net):
+            raise ValueError(
+                "InferenceEngine: quant='int8' on a recurrent net is "
+                "not supported (the decode step's per-channel scale "
+                "capture does not cover recurrent cells; use f32/bf16)"
+            )
         self.net = net
         self.buckets: Tuple[int, ...] = tuple(sorted({int(b) for b in buckets}))
         if self.buckets[0] < 1:
@@ -145,6 +152,26 @@ class InferenceEngine:
             self._partition = _partition
             self._mesh = layout.mesh()
         self._cache: Dict[Tuple[str, int, str], Any] = {}
+        # session-aware decode (serve/session.py): recurrent nets get
+        # a compiled single-token step whose carry is an executable
+        # argument, plus the per-session carry cache.  Non-recurrent
+        # nets share the zero-footprint DISABLED singleton.
+        self._stepper = None
+        self._step_cache: Dict[Tuple[str, int], Any] = {}
+        if _session.DecodeStepper.supports(net):
+            if layout is not None:
+                raise ValueError(
+                    "InferenceEngine: recurrent nets serve single-"
+                    "device (sessions are per-row state; layouts are "
+                    "for the stateless bucketed path)"
+                )
+            self._stepper = _session.DecodeStepper(
+                net, self.output, compute_dtype=self.compute_dtype
+            )
+        self.session_cache = (
+            _session.make_session_cache()
+            if self._stepper is not None else _session.DISABLED
+        )
         self._compile_lock = threading.Lock()
         # weights state: swapped atomically under _swap_lock; infer()
         # snapshots (params, state, generation) once per call so a swap
@@ -337,10 +364,16 @@ class InferenceEngine:
         size never pays a compile inside its latency budget.  Timed
         into ``warmup_s`` — with the persistent compile cache enabled
         (``serve/compile_cache.py``) a warm restart deserializes
-        instead of compiling, and this number is the proof."""
+        instead of compiling, and this number is the proof.  Recurrent
+        nets warm the decode step instead: their serving surface is
+        ``generate``, and bucketed sequence forwards would compile
+        programs sessions never run."""
         t0 = time.perf_counter()
-        for b in self.buckets:
-            self._executable(b)
+        if self._stepper is not None:
+            self._step_executable()
+        else:
+            for b in self.buckets:
+                self._executable(b)
         self.warmup_s = round(time.perf_counter() - t0, 3)
         return self
 
@@ -433,6 +466,172 @@ class InferenceEngine:
             outs.append(out[:take])
             start += take
         return (outs[0] if len(outs) == 1 else np.concatenate(outs)), gen
+
+    # ------------------------------------------------- sessions / decode
+    def _step_executable(self, n: int = 1, weights=None):
+        """The compiled single-token decode step for ``n`` parallel
+        session rows (``serve/session.py``) — ``step(params, state,
+        carry, token)`` with the carry donated on accelerators, AOT-
+        compiled once per (fingerprint, n).  The same key discipline as
+        the bucketed cache: a hot-swap of the same arch reuses it (a
+        pointer exchange), an arch change re-keys it."""
+        params, state, _, fingerprint = (
+            weights if weights is not None else self._weights_snapshot()
+        )
+        key = (fingerprint, int(n))
+        exe = self._step_cache.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._step_cache.get(key)
+            if exe is not None:
+                return exe
+            stepper = self._stepper
+            shape_of = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a), a.dtype), t
+            )
+            token_struct = jax.ShapeDtypeStruct(
+                (n,) + stepper.row_shape, jnp.dtype(stepper.token_dtype)
+            )
+            # donate the carry (arg 2): the step's output carry
+            # supersedes it — the session-state pointer exchange.  CPU
+            # skips donation like the bucketed path (noise only).
+            donate = () if jax.default_backend() == "cpu" else (2,)
+            exe = (
+                jax.jit(stepper.step_fn, donate_argnums=donate)
+                .lower(
+                    shape_of(params), shape_of(state),
+                    shape_of(stepper.init_carry(n)), token_struct,
+                )
+                .compile()
+            )
+            self._step_cache[key] = exe
+        return exe
+
+    def generate(
+        self,
+        tokens,
+        *,
+        session: Optional[str] = None,
+        steps: int = 0,
+        top_k: int = 5,
+    ) -> Dict[str, Any]:
+        """Multi-step autoregressive decode — the session-aware serving
+        entry point (``POST /generate``).
+
+        ``tokens``: the session's FULL token prefix (requests are
+        self-contained; the cache is an optimization, never a
+        correctness dependency).  ``session``: a session id — with one,
+        the per-session carry cache skips the already-processed prefix
+        (O(new tokens) instead of O(prefix)); without one (or on any
+        miss) the prefix replays through the same compiled step, so hit
+        and cold answers are bit-identical by construction.  ``steps``:
+        how many tokens to greedy-decode beyond the prefix.
+
+        Returns one JSON-able dict: generated ``tokens``, final-step
+        ``indices``/``probs`` (top-k), the weights ``gen``,
+        ``cache_state`` (hit/cold/stale_gen/rebuilt/disabled),
+        ``session_tokens`` (prefix incorporated so far) and
+        ``steps_run`` (tokens actually stepped — the O(1)-vs-O(prefix)
+        cost, observable per response)."""
+        if self._stepper is None:
+            raise ValueError(
+                "generate: model has no recurrent layer — serve a "
+                "decoder net (e.g. char_rnn_deploy.prototxt)"
+            )
+        stepper = self._stepper
+        if stepper.vocab is not None:
+            tokens = np.asarray(tokens, np.int64).ravel()
+            if tokens.size and not (
+                (0 <= tokens).all() and (tokens < stepper.vocab).all()
+            ):
+                raise ValueError(
+                    f"generate: token ids out of range "
+                    f"[0, {stepper.vocab})"
+                )
+            tokens = tokens.astype(np.int32)
+        else:
+            tokens = np.asarray(tokens, jnp.dtype(self.compute_dtype).name)
+            tokens = tokens.reshape((-1,) + stepper.row_shape)
+        steps = int(steps)
+        if tokens.size == 0:
+            raise ValueError("generate: empty token prefix")
+        if steps < 0:
+            raise ValueError(f"generate: steps must be >= 0, got {steps}")
+        if steps and stepper.vocab is None:
+            raise ValueError(
+                "generate: steps>0 needs a token-id net (Embed input) "
+                "to feed generated ids back"
+            )
+        weights = self._weights_snapshot()
+        params, state, gen, fingerprint = weights
+        cache = self.session_cache
+        carry = None
+        done = 0
+        out = None
+        cache_state = "cold" if session is None else None
+        if session is not None:
+            # pointer-exchange: take POPS the entry (its carry may be
+            # donated to the step below); put publishes the successor.
+            entry, cache_state = cache.take(
+                fingerprint, session, gen, tokens
+            )
+            if entry is not None:
+                carry, done, out = entry.carry, entry.tokens.size, (
+                    entry.last_out
+                )
+        if carry is None:
+            carry = stepper.init_carry(1)
+        exe = self._step_executable(1, weights)
+        t0 = time.perf_counter()
+        suffix = tokens[done:]
+        n_new = int(
+            len(suffix) if stepper.vocab is not None else suffix.shape[0]
+        )
+        with _trace.span("serve.generate", cat="serve",
+                         session=session or "", gen=gen,
+                         cache_state=cache_state, steps=steps,
+                         prefix=int(tokens.shape[0]), new=n_new):
+            for i in range(n_new):
+                tok = jnp.asarray(
+                    suffix[i : i + 1], jnp.dtype(stepper.token_dtype)
+                ).reshape((1,) + stepper.row_shape)
+                out, carry = exe(params, state, carry, tok)
+            generated: list = []
+            for _ in range(steps):
+                nxt = int(np.argmax(np.asarray(out)[0]))
+                generated.append(nxt)
+                out, carry = exe(
+                    params, state, carry,
+                    jnp.asarray([nxt], jnp.int32),
+                )
+        device_s = time.perf_counter() - t0
+        if stepper.vocab is not None and generated:
+            all_tokens = np.concatenate(
+                [tokens, np.asarray(generated, np.int32)]
+            )
+        else:
+            all_tokens = tokens
+        # np.asarray doubles as the device fence before publication
+        out_host = np.asarray(out)
+        if session is not None:
+            cache.put(
+                fingerprint, session, gen, all_tokens, carry, out_host
+            )
+        if self.metrics is not None:
+            self.metrics.record_batch(
+                1, rows=1, padded_rows=0, device_s=device_s
+            )
+        idx, probs = self.postprocess(out_host, top_k)
+        return {
+            "tokens": [int(t) for t in generated],
+            "indices": idx[0].tolist(),
+            "probs": probs[0].tolist(),
+            "gen": gen,
+            "cache_state": cache_state,
+            "session_tokens": int(all_tokens.shape[0]),
+            "steps_run": n_new + len(generated),
+        }
 
     # ------------------------------------------------------------------
     def postprocess(self, out: np.ndarray, top_k: int = 5):

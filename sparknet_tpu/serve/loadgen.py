@@ -118,6 +118,15 @@ def run_loadgen(
     }
 
 
+def zipf_weights(n: int, a: float) -> np.ndarray:
+    """Normalized Zipf pmf over ranks 1..n (``p(k) ∝ 1/k^a``); ``a=0``
+    degenerates to uniform.  The hot-session skew shape: real session
+    traffic concentrates on a few hot keys (ROADMAP item 4's
+    traffic-model brick)."""
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), float(a))
+    return w / w.sum()
+
+
 def run_http_loadgen(
     host: str,
     port: int,
@@ -129,6 +138,10 @@ def run_http_loadgen(
     seed: int = 0,
     timeout_s: float = 120.0,
     retries: int = 4,
+    sessions: int = 0,
+    session_zipf: float = 1.1,
+    session_steps: int = 1,
+    session_vocab: int = 96,
 ) -> dict:
     """The closed-loop generator over the WIRE — drives a router (or a
     single replica) through :class:`~sparknet_tpu.serve.server.Client`,
@@ -148,7 +161,21 @@ def run_http_loadgen(
     and every **slower-than-p99** request ride the result dict
     (``failed_request_traces`` / ``slow_request_traces``) — a
     ``BENCH_MODEL=serving_tier`` record can name the exact slow
-    requests it measured."""
+    requests it measured.
+
+    **Hot-session skew mode** (``sessions > 0``): instead of stateless
+    ``/classify`` rows, every request is a session step — it draws a
+    session id Zipf-distributed over ``sessions`` ids (exponent
+    ``session_zipf``; hot sessions dominate, the realistic traffic
+    shape — 0 is uniform), sends the session's FULL token prefix to
+    ``/generate`` with ``session_steps`` greedy continuations, and
+    appends the generated tokens to the session's history.  One
+    request per session in flight at a time (a session IS sequential),
+    so each session's prefix is deterministic given ``seed``.  The
+    record gains ``sessions`` (count/zipf/per-cache-state counts/hit
+    rate/migrations/hottest sessions) and ``session_failed_requests``
+    — the zero-is-the-bar gate for chaos runs (docs/SERVING.md
+    "Sessions")."""
     from ..telemetry import reqtrace
     from ..telemetry.registry import LatencyHistogram
     from .server import Client
@@ -161,6 +188,67 @@ def run_http_loadgen(
     samples = []  # (request index, trace id, latency seconds)
     generations = set()
     quants = set()
+    # session-mode state: histories + per-session in-flight locks +
+    # per-cache-state counts, all under `lock` except the step itself
+    session_probs = (
+        zipf_weights(sessions, session_zipf) if sessions > 0 else None
+    )
+    session_hist: dict = {}
+    session_locks: dict = {}
+    session_counts: dict = {}
+    session_states: dict = {}
+    session_migrated = [0]
+
+    def _session_step(i: int, rng, client) -> None:
+        k = int(rng.choice(sessions, p=session_probs))
+        sid = f"s{k}"
+        with lock:
+            slock = session_locks.setdefault(sid, threading.Lock())
+        ctx = reqtrace.mint()
+        tid = ctx.trace_id if ctx is not None else None
+        with slock:
+            with lock:
+                hist = list(
+                    session_hist.setdefault(sid, [k % session_vocab])
+                )
+            t0 = time.perf_counter()
+            try:
+                status, resp = client.generate(
+                    hist, session=sid, steps=session_steps,
+                    trace=reqtrace.to_header(ctx) if ctx is not None
+                    else None,
+                )
+                if status != 200:
+                    raise RuntimeError(
+                        f"HTTP {status}: {resp.get('error')}"
+                    )
+                if len(resp.get("tokens", ())) != session_steps:
+                    raise RuntimeError(
+                        f"{len(resp.get('tokens', ()))} tokens back, "
+                        f"asked {session_steps}"
+                    )
+            except Exception as e:
+                with lock:
+                    errors.append(f"req {i}: {type(e).__name__}: {e}")
+                    if tid is not None:
+                        failed_traces.append({"req": i, "trace": tid})
+                return
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.observe(dt)
+                samples.append((i, tid, dt))
+                session_hist[sid] = hist + [
+                    int(t) for t in resp["tokens"]
+                ]
+                session_counts[sid] = session_counts.get(sid, 0) + 1
+                st = str(resp.get("cache_state", "?"))
+                session_states[st] = session_states.get(st, 0) + 1
+                if resp.get("migrated"):
+                    session_migrated[0] += 1
+                if "gen" in resp:
+                    generations.add(int(resp["gen"]))
+                if resp.get("quant"):
+                    quants.add(str(resp["quant"]))
 
     def worker(wid: int):
         rng = np.random.default_rng(seed + wid)
@@ -171,6 +259,9 @@ def run_http_loadgen(
                 if i >= n_requests:
                     return
                 counter["next"] = i + 1
+            if sessions > 0:
+                _session_step(i, rng, client)
+                continue
             n = int(sizes[i % len(sizes)])
             rows = rng.normal(size=(n,) + tuple(input_shape)).astype(
                 np.float32
@@ -257,6 +348,34 @@ def run_http_loadgen(
         # client-side evidence, like served_generations for hot-swap)
         "served_quants": sorted(quants),
         "host_cpus": os.cpu_count(),
+        **(
+            {
+                # hot-session skew mode: the affinity-realistic story —
+                # how skewed the traffic was, what the cache did with
+                # it, and how many sessions migrated (killed/ejected
+                # holders); session_failed_requests is the chaos gate
+                "sessions": {
+                    "count": sessions,
+                    "zipf": session_zipf,
+                    "steps_per_request": session_steps,
+                    "distinct": len(session_counts),
+                    "states": dict(sorted(session_states.items())),
+                    "hit_rate": (
+                        round(
+                            session_states.get("hit", 0)
+                            / max(1, sum(session_states.values())), 4
+                        )
+                    ),
+                    "migrated": session_migrated[0],
+                    "hottest": sorted(
+                        session_counts.items(),
+                        key=lambda kv: -kv[1],
+                    )[:5],
+                },
+                "session_failed_requests": len(errors),
+            }
+            if sessions > 0 else {}
+        ),
     }
 
 

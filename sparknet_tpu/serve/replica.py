@@ -99,6 +99,12 @@ def add_engine_args(ap: argparse.ArgumentParser) -> None:
              "(PR 8); /classify then accepts cache_key bodies",
     )
     ap.add_argument(
+        "--session-cache-mb", type=float, default=None, metavar="MB",
+        help="per-session decode-state cache budget for recurrent "
+             "nets (serve/session.py; default SPARKNET_SESSION_CACHE_MB"
+             " or 64; 0 disables — every request replays its prefix)",
+    )
+    ap.add_argument(
         "--layout", default=None, metavar="AXES",
         help="multi-device replica layout, e.g. dp=2,tp=2: weights "
              "shard per the training rule table (docs/PARALLELISM.md) "
@@ -123,6 +129,14 @@ def build_stack(args, *, watch_in_server: bool = True):
         from ..parallel import partition
 
         layout = partition.parse_layout(args.layout, rules="tp")
+    session_mb = getattr(args, "session_cache_mb", None)
+    if session_mb is not None:
+        # the engine's SessionCache reads the env at construction —
+        # set it before the engine exists (0 = the disabled singleton)
+        if session_mb <= 0:
+            os.environ["SPARKNET_SESSION_CACHE"] = "0"
+        else:
+            os.environ["SPARKNET_SESSION_CACHE_MB"] = str(session_mb)
     quant = getattr(args, "quant", None) or (
         "bf16" if getattr(args, "bf16", False) else None
     )

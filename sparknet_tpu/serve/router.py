@@ -49,6 +49,7 @@ from __future__ import annotations
 import http.client
 import itertools
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -74,6 +75,7 @@ class Replica:
         self.warmup_s: Optional[float] = None
         self.weights_source: Optional[str] = None
         self.compile_cache: Optional[dict] = None
+        self.session_cache: Optional[dict] = None
         self.pid: Optional[int] = None
         self.forwarded = 0
         self.latency = LatencyHistogram()
@@ -91,6 +93,7 @@ class Replica:
             "warmup_s": self.warmup_s,
             "weights_source": self.weights_source,
             "compile_cache": self.compile_cache,
+            "session_cache": self.session_cache,
             "pid": self.pid,
             "forwarded": self.forwarded,
             "latency": self.latency.snapshot(),
@@ -112,6 +115,10 @@ class RouterMetrics:
         self.replica_deaths = 0
         self.respawns = 0
         self.rolls = 0           # completed rolling hot-swaps
+        # stateful sessions whose holder changed (eject/kill/retry):
+        # rebuilt on the new replica — correct by construction, but
+        # every one is a cold rebuild and MUST be measurable
+        self.session_migrations = 0
         self.request_latency = LatencyHistogram()
         REGISTRY.register_source("router", self)
 
@@ -126,13 +133,14 @@ class RouterMetrics:
                 "replica_deaths": self.replica_deaths,
                 "respawns": self.respawns,
                 "rolls": self.rolls,
+                "session_migrations": self.session_migrations,
                 "request_latency": self.request_latency.snapshot(),
             }
 
-    def inc(self, field: str, n: int = 1) -> None:
+    def inc(self, field: str, n: int = 1, event: Optional[str] = None) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
-        REGISTRY.counter("router_events", event=field).inc(n)
+        REGISTRY.counter("router_events", event=event or field).inc(n)
 
 
 class Router:
@@ -205,6 +213,17 @@ class Router:
         # `k mod 1000 < 500` window would)
         self._ab = itertools.count()
         self._lock = threading.Lock()       # replica verdicts + counts
+        # session-affinity table: session id -> replica index holding
+        # its decode state (serve/session.py).  Bounded LRU — affinity
+        # is a performance hint, never correctness (requests are
+        # self-contained; an evicted mapping just means one cold
+        # rebuild wherever the session lands next).
+        from collections import OrderedDict
+
+        self._session_holders: "OrderedDict[str, int]" = OrderedDict()
+        self._session_holders_max = int(
+            os.environ.get("SPARKNET_ROUTER_SESSIONS", "") or 4096
+        )
         self._rr = itertools.count()
         self._roll_lock = threading.Lock()  # one roll at a time
         self._tick = 0
@@ -284,10 +303,15 @@ class Router:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
-                if self.path == "/classify":
+                if self.path in ("/classify", "/generate"):
+                    # session affinity reads the HEADER only — the
+                    # router never parses request bodies (stateless
+                    # discipline; serve.Client sends the id both ways)
                     code, payload, headers = outer.dispatch(
                         body,
                         trace_header=self.headers.get("X-Sparknet-Trace"),
+                        path=self.path,
+                        session=self.headers.get("X-Sparknet-Session"),
                     )
                     self._send(
                         code, payload, "application/json", headers
@@ -365,6 +389,42 @@ class Router:
             ).set(rep.outstanding)
             return rep
 
+    def _pick_holder(self, index: int, exclude: set) -> Optional[Replica]:
+        """Affinity pick: the replica holding a session's decode state,
+        taken when it is healthy and not already tried this request —
+        else None and the caller falls back to least-outstanding (the
+        migration path; state is rebuilt from the request's prefix)."""
+        with self._lock:
+            rep = self.replicas[index]
+            if (
+                rep.healthy and rep.port is not None
+                and rep.index not in exclude
+            ):
+                rep.outstanding += 1
+                REGISTRY.gauge(
+                    "router_outstanding", replica=rep.index
+                ).set(rep.outstanding)
+                return rep
+            return None
+
+    def _session_holder(self, session: str) -> Optional[int]:
+        with self._lock:
+            idx = self._session_holders.get(session)
+            if idx is not None:
+                self._session_holders.move_to_end(session)
+            return idx
+
+    def _note_session(self, session: str, index: int) -> Optional[int]:
+        """Record who answered the session; returns the PREVIOUS holder
+        (a differing previous holder means the session migrated)."""
+        with self._lock:
+            prev = self._session_holders.get(session)
+            self._session_holders[session] = index
+            self._session_holders.move_to_end(session)
+            while len(self._session_holders) > self._session_holders_max:
+                self._session_holders.popitem(last=False)
+            return prev
+
     def _done(self, rep: Replica, latency_s: Optional[float] = None) -> None:
         with self._lock:
             rep.outstanding -= 1
@@ -386,11 +446,27 @@ class Router:
                 self.metrics.inc("ejects")
 
     def dispatch(
-        self, body: bytes, trace_header: Optional[str] = None
+        self, body: bytes, trace_header: Optional[str] = None,
+        path: str = "/classify", session: Optional[str] = None,
     ) -> Tuple[int, bytes, list]:
-        """Forward one /classify body; retries on peers until a replica
-        answers (anything but a connection failure / 5xx counts as an
-        answer — 400s are the client's problem, not the tier's).
+        """Forward one /classify or /generate body; retries on peers
+        until a replica answers (anything but a connection failure /
+        5xx counts as an answer — 400s are the client's problem, not
+        the tier's).
+
+        ``session`` (the ``X-Sparknet-Session`` header) turns on
+        **session-affinity** dispatch: the request goes to the replica
+        holding the session's decode state (serve/session.py), falling
+        back to least-outstanding when the holder is down/ejected.
+        Whoever answers becomes the new holder; a holder CHANGE is a
+        **migration** — the state was rebuilt cold on the new replica
+        (correct by construction, requests carry their full prefix) —
+        counted in ``router_events{event="session_migrate"}`` and
+        stamped into the response (``"migrated": true`` plus an
+        ``X-Sparknet-Migrated`` header) so a retried/killed-holder
+        session is measured, never silent.  The session id also rides
+        the retry hop's span args, so a migrated session is visible in
+        the stitched waterfall.
 
         The router is the tier's **stitching point**
         (telemetry/reqtrace.py): it adopts the client's trace context
@@ -425,7 +501,13 @@ class Router:
         # short wait — a respawning replica (or a rolling swap) is a
         # latency blip, not an outage
         for attempt in range(2 * len(self.replicas) + 1):
-            rep = self._pick(tried, prefer_quant=want_quant)
+            rep = None
+            if session is not None:
+                holder = self._session_holder(session)
+                if holder is not None:
+                    rep = self._pick_holder(holder, tried)
+            if rep is None:
+                rep = self._pick(tried, prefer_quant=want_quant)
             if rep is None:
                 if attempt and tried:
                     # every healthy peer tried and failed this pass:
@@ -445,23 +527,28 @@ class Router:
                     "from": last_fail[0],
                     "to": rep.index,
                     "reason": last_fail[1],
+                    **({"session": session} if session is not None else {}),
                 }), flush=True)
             hop = reqtrace.hop(
                 rctx,
                 "router.retry" if last_fail is not None else
                 "router.dispatch",
             )
-            fwd_headers = (
-                {reqtrace.HEADER: reqtrace.to_header(hop.ctx)}
-                if hop.ctx is not None else None
-            )
+            fwd_headers = {}
+            if hop.ctx is not None:
+                fwd_headers[reqtrace.HEADER] = reqtrace.to_header(hop.ctx)
+            if session is not None:
+                fwd_headers["X-Sparknet-Session"] = session
             hop_args = {"replica": rep.index}
+            if session is not None:
+                hop_args["session"] = session
             if last_fail is not None:
                 hop_args["retry_of"] = last_fail[0]
                 hop_args["reason"] = last_fail[1]
             try:
                 status, payload, resp_headers = self._replica_request(
-                    rep, "POST", "/classify", body, headers=fwd_headers
+                    rep, "POST", path, body,
+                    headers=fwd_headers or None,
                 )
             except (OSError, http.client.HTTPException) as e:
                 self._done(rep)
@@ -509,6 +596,23 @@ class Router:
                 ),
             )
             hdrs = [("X-Sparknet-Replica", str(rep.index))]
+            if session is not None and status < 400:
+                prev = self._note_session(session, rep.index)
+                if prev is not None and prev != rep.index:
+                    # the session MIGRATED: its state was rebuilt cold
+                    # on this replica.  Count it and stamp the response
+                    # — a killed holder must be measurable, not silent.
+                    self.metrics.inc(
+                        "session_migrations", event="session_migrate"
+                    )
+                    hdrs.append(("X-Sparknet-Migrated", "1"))
+                    try:
+                        doc = json.loads(payload)
+                        doc["migrated"] = True
+                        doc.setdefault("cache_state", "cold")
+                        payload = json.dumps(doc).encode()
+                    except ValueError:
+                        pass
             if rctx is not None:
                 reqtrace.finish(rctx, dt)
                 hdrs.append((reqtrace.HEADER, reqtrace.to_header(rctx)))
@@ -546,6 +650,7 @@ class Router:
                 rep.warmup_s = doc.get("warmup_s")
                 rep.weights_source = doc.get("weights_source")
                 rep.compile_cache = doc.get("compile_cache")
+                rep.session_cache = doc.get("session_cache")
                 rep.pid = doc.get("pid")
             else:
                 rep.consecutive_fails += 1
@@ -702,8 +807,11 @@ class Router:
         healthy = sum(1 for r in reps if r["healthy"])
         gens = {r["generation"] for r in reps if r["healthy"]}
         quants = {r["quant"] for r in reps if r["healthy"]}
+        with self._lock:
+            sessions_tracked = len(self._session_holders)
         return {
             "quant_ab": self.quant_ab,
+            "sessions_tracked": sessions_tracked,
             "quants": sorted(q for q in quants if q is not None),
             "status": (
                 "ok" if healthy == len(reps)

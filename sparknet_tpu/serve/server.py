@@ -199,6 +199,12 @@ class InferenceServer:
                         payload["data_cache"] = (
                             outer.data_cache.metrics.snapshot()
                         )
+                    sessions = getattr(outer.engine, "session_cache", None)
+                    if sessions is not None and sessions.enabled:
+                        # the session-cache story next to gen/quant: a
+                        # router reads resident sessions + hit counters
+                        # off the same scrape that drives affinity
+                        payload["session_cache"] = sessions.snapshot()
                     self._reply(200, payload)
                 elif self.path == "/dash":
                     # the zero-dependency live dashboard
@@ -256,6 +262,9 @@ class InferenceServer:
                         return
                     code, payload = outer.reload(req.get("weights"))
                     self._reply(code, payload)
+                    return
+                if self.path == "/generate":
+                    self._do_generate()
                     return
                 if self.path != "/classify":
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -414,6 +423,102 @@ class InferenceServer:
                     # the distinct set as served_quants)
                     "quant": getattr(outer.engine, "quant", "f32"),
                 }
+                with reqtrace.span(
+                    rhop.ctx if rhop is not None else None,
+                    "serve.serialize",
+                ) as sp:
+                    body = json.dumps(payload).encode()
+                    sp.note(bytes=len(body))
+                self._send(200, body, "application/json",
+                           trace_headers(200))
+
+            def _do_generate(self):
+                """``POST /generate`` — the session-aware decode route
+                (serve/session.py): body ``{"session": id?, "tokens":
+                [...], "steps": K, "top_k": k}``; the session id may
+                also ride the ``X-Sparknet-Session`` header (what the
+                router's affinity dispatch reads).  Runs through the
+                batcher's serialized call path, so decode shares the
+                classify path's backpressure, deadline shedding and
+                error mapping."""
+                rctx = rhop = None
+                if reqtrace.enabled():
+                    rctx = reqtrace.parse(
+                        self.headers.get(reqtrace.HEADER)
+                    ) or reqtrace.mint()
+                    rhop = reqtrace.hop(rctx, "server.request")
+
+                def trace_headers(status):
+                    if rhop is None:
+                        return ()
+                    dur_s = rhop.finish(status=status)
+                    hdrs = [(reqtrace.HEADER, reqtrace.to_header(rctx))]
+                    if rctx.root:
+                        reqtrace.finish(rctx, dur_s or 0.0)
+                    else:
+                        hdrs.append((
+                            reqtrace.SPANS_HEADER,
+                            reqtrace.spans_header_value(
+                                reqtrace.take(rctx.trace_id)
+                            ),
+                        ))
+                    return hdrs
+
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    tokens = req["tokens"]
+                    steps = int(req.get("steps", 0))
+                    top_k = int(req.get("top_k", outer.default_top_k))
+                    session = req.get("session") or self.headers.get(
+                        "X-Sparknet-Session"
+                    )
+                except (KeyError, ValueError, TypeError) as e:
+                    outer.metrics.record_error()
+                    self._reply(400, {"error": f"bad request: {e}"},
+                                headers=trace_headers(400))
+                    return
+                try:
+                    fut = outer.batcher.submit_call(
+                        lambda: outer.engine.generate(
+                            tokens, session=session, steps=steps,
+                            top_k=top_k,
+                        ),
+                        ctx=rhop.ctx if rhop is not None else None,
+                    )
+                except Backpressure as e:
+                    outer.metrics.record_error()
+                    self._reply(
+                        503, {"error": str(e)},
+                        headers=(("Retry-After", "1"),)
+                        + tuple(trace_headers(503)),
+                    )
+                    return
+                try:
+                    payload = fut.result(timeout=outer.request_timeout_s)
+                except FuturesTimeout:
+                    outer.metrics.record_error()
+                    fut.cancel()
+                    self._reply(504, {"error": "generate timed out"},
+                                headers=trace_headers(504))
+                    return
+                except DeadlineExceeded as e:
+                    self._reply(
+                        503, {"error": str(e)},
+                        headers=(("Retry-After", "1"),)
+                        + tuple(trace_headers(503)),
+                    )
+                    return
+                except Exception as e:
+                    code = 400 if isinstance(e, ValueError) else 500
+                    self._reply(
+                        code, {"error": f"{type(e).__name__}: {e}"},
+                        headers=trace_headers(code),
+                    )
+                    return
+                if session:
+                    payload["session"] = session
+                payload["quant"] = getattr(outer.engine, "quant", "f32")
                 with reqtrace.span(
                     rhop.ctx if rhop is not None else None,
                     "serve.serialize",
@@ -665,6 +770,35 @@ class Client:
         return self._request(
             "POST", "/classify", {"rows": rows.tolist(), "top_k": top_k},
             headers=headers,
+        )
+
+    def generate(
+        self,
+        tokens,
+        session: Optional[str] = None,
+        steps: int = 0,
+        top_k: int = 5,
+        trace: Optional[str] = None,
+    ):
+        """Session-aware autoregressive decode (``POST /generate``).
+        ``tokens`` is the session's FULL prefix (self-contained
+        requests — docs/SERVING.md "Sessions"); ``session`` rides both
+        the body and the ``X-Sparknet-Session`` header so a router's
+        affinity dispatch can read it without parsing the body."""
+        headers = {}
+        if trace:
+            headers[reqtrace.HEADER] = trace
+        if session:
+            headers["X-Sparknet-Session"] = str(session)
+        payload = {
+            "tokens": [int(t) for t in np.asarray(tokens).ravel()],
+            "steps": int(steps),
+            "top_k": int(top_k),
+        }
+        if session:
+            payload["session"] = str(session)
+        return self._request(
+            "POST", "/generate", payload, headers=headers or None
         )
 
     def classify_cached(self, cache_key: str, top_k: int = 5):

@@ -56,6 +56,7 @@ _HOP_COLORS = {
     "batcher.wait": ("#eda100", "#c98500"),
     "batcher.shed": ("#d03b3b", "#e66767"),
     "engine.compute": ("#4a3aa7", "#9085e9"),
+    "engine.generate": ("#2a6a6a", "#3d8f8f"),
     "serve.serialize": ("#e87ba4", "#d55181"),
 }
 
@@ -219,6 +220,7 @@ def _router_section(router: dict) -> str:
         st = "good" if r.get("healthy") else "serious"
         label = "healthy" if r.get("healthy") else "ejected"
         rl = r.get("latency") or {}
+        sc = r.get("session_cache") or {}
         fmt = lambda v: f"{v:.1f}" if v is not None else "—"
         rows.append(
             f'<tr><td>replica {r.get("index")}</td>'
@@ -228,6 +230,7 @@ def _router_section(router: dict) -> str:
             f'<td>{r.get("outstanding", 0)}</td>'
             f'<td>{_esc(r.get("generation"))}</td>'
             f'<td>{_esc(r.get("quant") or "f32")}</td>'
+            f'<td>{sc.get("entries", 0) if sc.get("enabled") else "—"}</td>'
             f'<td>{r.get("forwarded", 0)}</td>'
             f'<td>{fmt(rl.get("p50_ms"))}</td>'
             f'<td>{fmt(rl.get("p99_ms"))}</td></tr>'
@@ -235,7 +238,7 @@ def _router_section(router: dict) -> str:
     table = (
         '<table class="data"><thead><tr><th>replica</th><th>state</th>'
         "<th>addr</th><th>outstanding</th><th>gen</th>"
-        "<th>precision</th><th>forwarded</th>"
+        "<th>precision</th><th>sessions</th><th>forwarded</th>"
         "<th>p50 ms</th><th>p99 ms</th></tr></thead>"
         f'<tbody>{"".join(rows)}</tbody></table>'
     )
@@ -243,6 +246,58 @@ def _router_section(router: dict) -> str:
         f'<section><h2>Serving tier</h2>'
         f'<div class="tiles">{"".join(tiles)}</div>{table}</section>'
     )
+
+
+def _session_section(session: dict) -> str:
+    """Session-cache panel (ISSUE 13): hit/miss/evict/stale-gen tiles
+    from the ``session_cache`` registry source (a replica's own cache)
+    or the router-side aggregate over replica health scrapes.  A
+    hot-swap shows up as a ``stale gen`` pulse — every invalidation is
+    a counted rebuild, never a silently-wrong answer."""
+    total = sum(
+        session.get(k, 0)
+        for k in ("hits", "misses", "stale_gen", "rebuilt")
+    )
+    hit_rate = (
+        f"{session.get('hits', 0) / total:.0%} hit rate" if total else ""
+    )
+    mb = session.get("resident_bytes", 0) / (1 << 20)
+    cap = session.get("max_bytes", 0) / (1 << 20)
+    tiles = [
+        _tile("resident sessions", str(session.get("entries", 0)),
+              f"{mb:.2f} / {cap:g} MB"),
+        _tile("hits", str(session.get("hits", 0)), hit_rate),
+        _tile("misses", str(session.get("misses", 0)), "cold rebuilds"),
+        _tile("evictions", str(session.get("evictions", 0)),
+              "LRU-by-hit"),
+        _tile("stale gen", str(session.get("stale_gen", 0)),
+              "hot-swap invalidations"),
+        _tile("prefix rebuilt", str(session.get("rebuilt", 0)),
+              "history mismatch"),
+    ]
+    return (
+        '<section><h2>Sessions <span class="muted">'
+        "(per-session decode-state cache; docs/SERVING.md)</span></h2>"
+        f'<div class="tiles">{"".join(tiles)}</div></section>'
+    )
+
+
+def _session_aggregate(router: Optional[dict]) -> Optional[dict]:
+    """Sum the replicas' ``session_cache`` health blocks into one
+    router-level view (entries, hits, misses, ...)."""
+    if router is None:
+        return None
+    agg: Dict[str, int] = {}
+    seen = False
+    for r in router.get("replicas", []):
+        sc = r.get("session_cache")
+        if not sc or not sc.get("enabled"):
+            continue
+        seen = True
+        for k in ("entries", "resident_bytes", "max_bytes", "hits",
+                  "misses", "evictions", "stale_gen", "rebuilt"):
+            agg[k] = agg.get(k, 0) + int(sc.get(k) or 0)
+    return dict(agg, enabled=True) if seen else None
 
 
 def _reqtrace_section(records: List[dict]) -> str:
@@ -389,6 +444,11 @@ def render_html(
         _slo_tile("p95", lat.get("p95_ms"), budget / 2),
         _slo_tile("p99", lat.get("p99_ms"), budget),
     ]
+    # session panel: this process's own cache (registry source) on a
+    # replica, or the aggregate over replica scrapes on the router
+    session = registry_snapshot.get("session_cache")
+    if not (session and session.get("enabled")):
+        session = _session_aggregate(router)
     active_anoms = anomalies or []
     health = serve.get("health", "ok")
     degraded = health != "ok" or any(
@@ -406,6 +466,7 @@ def render_html(
   <span class="muted">rendered {time.strftime('%H:%M:%S')}, refreshes every {refresh_s}s</span>
 </header>
 {_router_section(router) if router is not None else ''}
+{_session_section(session) if session else ''}
 {_reqtrace_section(reqtrace) if reqtrace else ''}
 <section><h2>Serving</h2><div class="tiles">{''.join(tiles)}</div></section>
 <section><h2>Latency SLO <span class="muted">(p99 budget {budget:g} ms)</span></h2>

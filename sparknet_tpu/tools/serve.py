@@ -169,6 +169,8 @@ def _replica_argv(args, run_dir: str, index: int, spawn: int):
         argv += ["--compile-cache", args.compile_cache]
     if args.data_cache:
         argv += ["--data-cache", args.data_cache]
+    if getattr(args, "session_cache_mb", None) is not None:
+        argv += ["--session-cache-mb", str(args.session_cache_mb)]
     # NOTE: --snapshot-watch is deliberately NOT forwarded — under a
     # router the roll is router-driven, one replica at a time
     return argv
