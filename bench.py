@@ -52,6 +52,14 @@ Env knobs:
                              ms, interleaved rounds, plus the
                              scripts/fusion_audit.py record of a
                              traced legacy run
+  BENCH_MODEL=reshard        live-resharding A/B (ISSUE 14): mid-run
+                             dp=4 -> dp=2,tp=2 migration on the
+                             virtual mesh — relayout_ms (in-place
+                             device_put + step swap) vs a warm-restart
+                             baseline (snapshot + fresh solver +
+                             restore + recompile), bitwise_preserved
+                             zero-tolerance, and the warm
+                             reshard-back cache hit
   BENCH_MODEL=session_serving session-aware serving A/B (ISSUE 13):
                              per-request latency of a session step
                              served from the decode-state cache vs the
@@ -1665,6 +1673,143 @@ def bench_sharding(platform: str) -> dict:
     }
 
 
+def bench_reshard(platform: str) -> dict:
+    """Live-resharding A/B (``BENCH_MODEL=reshard``, ISSUE 14): a
+    mid-run ``dp=4`` -> ``dp=2,tp=2`` migration on the virtual mesh,
+    measured against the pre-PR alternative — a warm restart (snapshot
+    + fresh solver + restore + recompile).
+
+    The restart arm is the IN-PROCESS analog (no process spawn, no
+    backend re-init — both of which only add to a real restart), so
+    ``reshard_vs_restart_speedup`` understates the real win; it still
+    must clear the ≥1x absolute gate in ``scripts/bench_diff.py``.
+    ``bitwise_preserved`` is the zero-tolerance gate: ``device_put`` is
+    data movement, a migration that perturbs one bit is a bug.  All
+    timing rides a telemetry Timeline (no ad-hoc clocks)."""
+    import contextlib
+    import io
+    import tempfile
+
+    from sparknet_tpu.parallel import ParallelSolver, partition
+    from sparknet_tpu.parallel.partition import parse_layout
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.telemetry import timeline as _ttl
+
+    zoo = os.path.join(_HERE, "sparknet_tpu", "models", "prototxt")
+    sp = caffe_pb.load_solver(
+        os.path.join(zoo, "cifar10_quick_solver.prototxt")
+    )
+    bs = int(os.environ.get("BENCH_BATCH", 16))
+    shapes = {"data": (bs, 32, 32, 3), "label": (bs,)}
+    rng = np.random.default_rng(0)
+    one = {
+        "data": jnp.asarray(rng.normal(size=shapes["data"]), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(bs,)), jnp.int32),
+    }
+
+    def feed():
+        while True:
+            yield one
+
+    tl = _ttl.Timeline(fence=True)
+    tl.start()
+
+    def timed(name, fn):
+        before = tl.phase_seconds().get(name, 0.0)
+        with tl.phase(name):
+            out = fn()
+            jax.block_until_ready(jax.tree_util.tree_leaves(out) or [0])
+        return out, round(
+            1e3 * (tl.phase_seconds().get(name, 0.0) - before), 3
+        )
+
+    tmpd = tempfile.mkdtemp(prefix="bench_reshard_")
+    solver = ParallelSolver(
+        sp, shapes, solver_dir=zoo, layout=parse_layout("dp=4", rules="tp")
+    )
+    solver.step(feed(), 1)  # compile layout A
+    partition.fence_once(solver.step(feed(), 3))  # warm
+    snap = os.path.join(tmpd, "mid.solverstate.npz")
+    solver.save(snap)
+    host = lambda t: jax.tree_util.tree_map(
+        lambda x: np.array(x), jax.device_get(t)
+    )
+    before_params = host(solver.params)
+    before_opt = host(solver.opt_state)
+
+    # ---- live arm: in-place migration + the compile of layout B's step
+    rec = solver.reshard("dp=2,tp=2", reason="bench")
+    bitwise = all(
+        (np.asarray(x) == np.asarray(y)).all()
+        for (_, x), (_, y) in zip(
+            partition.tree_paths(before_params),
+            partition.tree_paths(host(solver.params)),
+        )
+    ) and all(
+        (np.asarray(x) == np.asarray(y)).all()
+        for (_, x), (_, y) in zip(
+            partition.tree_paths(before_opt),
+            partition.tree_paths(host(solver.opt_state)),
+        )
+    )
+    _, first_cold_ms = timed(
+        "reshard_first_step", lambda: solver.step(feed(), 1)
+    )
+    reshard_total_ms = round(rec["relayout_ms"] + first_cold_ms, 3)
+
+    # ---- warm path: back to A (seeded hit), then B again — the
+    # per-layout step cache must serve both, no retrace/recompile
+    rec_back = solver.reshard("dp=4", reason="bench")
+    _, back_step_ms = timed("reshard_back_step", lambda: solver.step(feed(), 1))
+    rec_warm = solver.reshard("dp=2,tp=2", reason="bench")
+    _, first_warm_ms = timed(
+        "reshard_warm_step", lambda: solver.step(feed(), 1)
+    )
+
+    # ---- baseline arm: the warm restart this PR replaces — fresh
+    # solver in layout B + verified-snapshot restore + first (compiled)
+    # step; process spawn and backend init would come on top
+    def restart():
+        s2 = ParallelSolver(
+            sp, shapes, solver_dir=zoo,
+            layout=parse_layout("dp=2,tp=2", rules="tp"),
+        )
+        with contextlib.redirect_stderr(io.StringIO()):  # relayout notice
+            s2.restore(snap)
+        s2.step(feed(), 1)
+        return s2.params
+
+    _, restart_ms = timed("warm_restart", restart)
+
+    return {
+        "metric": "reshard_relayout_ms",
+        "value": rec["relayout_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "platform": platform,
+        "devices": len(jax.devices()),
+        "batch_size": bs,
+        "relayout_ms": rec["relayout_ms"],
+        "first_step_ms_cold": first_cold_ms,
+        "reshard_total_ms": reshard_total_ms,
+        "restart_ms": restart_ms,
+        "reshard_vs_restart_speedup": round(
+            restart_ms / max(reshard_total_ms, 1e-9), 3
+        ),
+        "relayout_warm_ms": rec_warm["relayout_ms"],
+        "first_step_ms_warm": first_warm_ms,
+        "cache_hit_warm": (
+            rec_back["cache"] == "hit" and rec_warm["cache"] == "hit"
+        ),
+        "bitwise_preserved": bool(bitwise),
+        "leaves_moved": rec["leaves_moved"],
+        "bytes_relaid": rec["bytes_relaid"],
+        "layout": solver.layout_report(),
+        "migration": {"cold": rec, "back": rec_back, "warm": rec_warm,
+                      "back_step_ms": back_step_ms},
+    }
+
+
 def bench_bert(platform: str) -> dict:
     from sparknet_tpu.data.text import mlm_dataset, mlm_feed
     from sparknet_tpu.models.bert import BertConfig, BertMLM
@@ -1751,7 +1896,9 @@ def main() -> None:
 
     honor_platform_env()
     mode = os.environ.get("BENCH_MODEL", "alexnet")
-    if mode in ("comm", "sharding") and not os.environ.get("BENCH_COMM_NATIVE"):
+    if mode in ("comm", "sharding", "reshard") and not os.environ.get(
+        "BENCH_COMM_NATIVE"
+    ):
         # the comm A/B needs a mesh; the tunnel exposes one chip — run
         # on 8 virtual CPU devices (same device-forcing recipe as the
         # driver's dryrun_multichip) BEFORE any backend init
@@ -1766,6 +1913,8 @@ def main() -> None:
         runner = bench_comm
     elif mode == "sharding":
         runner = bench_sharding
+    elif mode == "reshard":
+        runner = bench_reshard
     elif mode == "input_pipeline":
         runner = bench_input_pipeline
     elif mode == "data_plane":
@@ -1785,8 +1934,8 @@ def main() -> None:
         # Exception and still emits the JSON error record
         raise ValueError(
             f"BENCH_MODEL={mode!r}: want "
-            f"bert|input_pipeline|data_plane|comm|sharding|serving_tier|"
-            f"quant_serving|session_serving|fusion|"
+            f"bert|input_pipeline|data_plane|comm|sharding|reshard|"
+            f"serving_tier|quant_serving|session_serving|fusion|"
             f"{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
@@ -1828,6 +1977,8 @@ if __name__ == "__main__":
                         if mode == "comm"
                         else "sharding_unified_step_ms"
                         if mode == "sharding"
+                        else "reshard_relayout_ms"
+                        if mode == "reshard"
                         else "data_plane_cached_rows_per_sec"
                         if mode == "data_plane"
                         else "serving_tier_p99_ms_continuous"

@@ -209,6 +209,33 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
             add(key, old.get(key), b, "", bad,
                 f"≥{floor:g}x floor" if bad
                 else ("cpu-informational" if not speed_gated else "ok"))
+    # live-resharding records (BENCH_MODEL=reshard, ISSUE 14): the
+    # in-place migration must stay cheaper than the warm restart it
+    # replaces (>=1x ABSOLUTE, like fusion's bar — the restart arm
+    # already understates the real cost by excluding process spawn and
+    # backend init), the migration must preserve weights BITWISE
+    # (zero tolerance), and the relayout/restart costs diff
+    # lower-is-better against the previous record
+    for key in ("relayout_ms", "reshard_total_ms", "restart_ms"):
+        a, b = find_key(old, key), find_key(new, key)
+        if a and b:
+            rise = (b - a) / a
+            add(key, a, b, "", rise > args.throughput_pct / 100.0,
+                f"{rise:+.1%}")
+    b = new.get("reshard_vs_restart_speedup")
+    if b is not None:
+        bad = b < args.reshard_speedup_min
+        add("reshard_vs_restart_speedup",
+            old.get("reshard_vs_restart_speedup"), b, "", bad,
+            f"≥{args.reshard_speedup_min:g}x is the bar" if bad else "ok")
+    bp = new.get("bitwise_preserved")
+    if bp is not None:
+        add("bitwise_preserved", None, float(bool(bp)), "", not bp,
+            "ok" if bp else "migration PERTURBED weights")
+    ch = new.get("cache_hit_warm")
+    if ch is not None:
+        add("reshard_cache_hit_warm", None, float(bool(ch)), "", not ch,
+            "ok" if ch else "seen layout RECOMPILED")
     # fusion records (BENCH_MODEL=fusion): the audit-driven fix must
     # actually cut step time — an absolute >1.0x bar, like
     # failed_requests' zero
@@ -306,6 +333,9 @@ def main(argv=None) -> int:
     ap.add_argument("--int8-bytes-x", type=float, default=1.5,
                     help="int8 resident-weight-bytes compression "
                          "floor vs f32, x (default 1.5)")
+    ap.add_argument("--reshard-speedup-min", type=float, default=1.0,
+                    help="live-reshard cost floor vs a warm restart, x "
+                         "(reshard records; absolute gate, default 1)")
     ap.add_argument("--session-speedup-min", type=float, default=5.0,
                     help="session-cache cached-vs-cold per-request "
                          "latency floor, x (session_serving records; "

@@ -268,6 +268,21 @@ else
   exit 1
 fi
 
+# ---- reshard smoke (ISSUE 14): a 5-iter caffe train on a 2×2 virtual
+# mesh migrates dp=4 -> dp=2,tp=2 IN PLACE at iteration 2 (request-file
+# control surface) — the reshard: line must appear, the final weights
+# must be BITWISE equal to a fresh layout-B run replayed from the
+# reshard-point snapshot, post-reshard snapshots must carry the new
+# layout env, and resharding back to seen layouts must hit the
+# per-layout compile cache (no new executable).  Migration timing rides
+# the telemetry timeline — the perf_counter allowlist is unchanged.
+if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/reshard_smoke.py; then
+  echo "check.sh: reshard smoke OK (mid-run dp=4 -> dp=2,tp=2, bitwise vs replay, cache-warm reshard-back)"
+else
+  echo "check.sh: reshard SMOKE FAILED"
+  exit 1
+fi
+
 # ---- data-plane smoke (ISSUE 8): pack a tiny synthetic dataset, train
 # 5 CPU iters three ways — legacy in-memory feed, packed shard readers
 # cold (filling the decoded-batch cache), and packed again served from

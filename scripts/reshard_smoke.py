@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Live-resharding smoke (ISSUE 14) — the check.sh gate.
+
+Three short ``caffe train`` runs on a virtual CPU mesh:
+
+1. **migrate** — 5 iterations starting at ``--layout dp=4`` with a
+   reshard request file asking for ``dp=2,tp=2`` at iteration 2: the
+   run must print the ``reshard:`` JSON line (from/to/cache/cost), its
+   final ``layout:`` line must report the NEW mesh, and the snapshots
+   written AFTER the migration must carry the new layout in their env
+   (the satellite fix: a later --auto-resume must not relayout
+   backwards).
+2. **replay** — a fresh run started in ``dp=2,tp=2`` from run 1's
+   iteration-2 snapshot (the reshard point, written pre-migration)
+   must reach iteration 5 with BITWISE-equal final weights: the
+   in-place migration is indistinguishable from a restart into the new
+   layout, minus the restart.
+3. **cache** — a run resharding A -> B -> A -> B must report the
+   second and third migrations as compile-cache hits (the per-layout
+   step cache; ``net_fingerprint`` already folds the layout in, so
+   neither the in-memory nor any persistent cache can alias).
+
+No process is ever restarted mid-run — that is the point.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+NET = """\
+name: "reshard_smoke"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 10
+          weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+
+
+def write_solver(d, name, max_iter, snapshot=2):
+    path = os.path.join(d, f"solver_{name}.prototxt")
+    with open(path, "w") as fh:
+        fh.write(
+            "net: \"net.prototxt\"\n"
+            "base_lr: 0.01\n"
+            "lr_policy: \"fixed\"\n"
+            f"max_iter: {max_iter}\n"
+            "display: 0\n"
+            f"snapshot: {snapshot}\n"
+            f"snapshot_prefix: \"{d}/w_{name}\"\n"
+        )
+    return path
+
+
+def train(d, solver_path, layout, extra=(), request=None, devices=4):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    env.pop("SPARKNET_RESHARD_REQUEST", None)
+    if request is not None:
+        req_path = os.path.join(d, f"req_{os.path.basename(solver_path)}.json")
+        with open(req_path, "w") as fh:
+            json.dump(request, fh)
+        env["SPARKNET_RESHARD_REQUEST"] = req_path
+    cmd = [
+        sys.executable, "-m", "sparknet_tpu.tools.caffe", "train",
+        f"--solver={solver_path}", "--synthetic", "--synthetic-n=64",
+        "--batch-size=8", "--data-workers=0", "--native-loader=off",
+        f"--layout={layout}", *extra,
+    ]
+    out = subprocess.run(
+        cmd, cwd=ROOT, env=env, timeout=280,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    if out.returncode != 0:
+        sys.stderr.write(out.stdout[-4000:])
+        raise SystemExit(f"reshard_smoke: train exited {out.returncode}")
+    return out.stdout
+
+
+def reshard_lines(log):
+    return [
+        json.loads(l[len("reshard: "):])
+        for l in log.splitlines() if l.startswith("reshard: ")
+    ]
+
+
+def layout_line(log):
+    lines = [l for l in log.splitlines() if l.startswith("layout: ")]
+    assert lines, "no layout: line"
+    return json.loads(lines[-1][len("layout: "):])
+
+
+def main():
+    import numpy as np  # after env setup; the trains run in subprocesses
+
+    d = tempfile.mkdtemp(prefix="_reshard_smoke.")
+    with open(os.path.join(d, "net.prototxt"), "w") as fh:
+        fh.write(NET)
+
+    # ---- run 1: migrate mid-run ---------------------------------------
+    s_a = write_solver(d, "a", max_iter=5)
+    log_a = train(d, s_a, "dp=4",
+                  request=[{"layout": "dp=2,tp=2", "at_iter": 2}])
+    recs = reshard_lines(log_a)
+    assert len(recs) == 1, f"want 1 reshard: line, got {len(recs)}"
+    rec = recs[0]
+    assert rec["from"] == "dp=4" and rec["to"] == "dp=2,tp=2", rec
+    assert rec["at_iter"] == 2 and rec["cache"] == "miss", rec
+    assert rec["relayout_ms"] >= 0 and rec["leaves_moved"] >= 1, rec
+    assert layout_line(log_a)["mesh"] == {"dp": 2, "tp": 2}, (
+        "final layout: line must report the post-reshard mesh"
+    )
+    assert "relayout (live reshard)" in log_a, (
+        "the aggregated relayout notice must name the live path"
+    )
+
+    # the post-reshard snapshot env carries the NEW layout (satellite)
+    sys.path.insert(0, ROOT)
+    from sparknet_tpu.solver.snapshot import load_state
+
+    env5 = load_state(os.path.join(d, "w_a_iter_5.solverstate.npz"))["env"]
+    assert json.loads(str(env5["layout"]))["axes"] == [["dp", 2], ["tp", 2]], (
+        f"post-reshard snapshot env still carries the old layout: "
+        f"{env5['layout']}"
+    )
+
+    # ---- run 2: replay from the reshard-point snapshot in layout B ----
+    s_b = write_solver(d, "b", max_iter=5)
+    log_b = train(
+        d, s_b, "dp=2,tp=2",
+        extra=(f"--restore={d}/w_a_iter_2.solverstate.npz",),
+    )
+    assert not reshard_lines(log_b)
+    a = np.load(os.path.join(d, "w_a_iter_5.npz"))
+    b = np.load(os.path.join(d, "w_b_iter_5.npz"))
+    for k in a.files:
+        assert (a[k] == b[k]).all(), (
+            f"resharded run != fresh layout-B replay at {k}: "
+            f"max |d| {np.abs(a[k] - b[k]).max()}"
+        )
+
+    # ---- run 3: reshard back to seen layouts hits the compile cache ---
+    s_c = write_solver(d, "c", max_iter=7)
+    log_c = train(d, s_c, "dp=4", request=[
+        {"layout": "dp=2,tp=2", "at_iter": 2},
+        {"layout": "dp=4", "at_iter": 4},
+        {"layout": "dp=2,tp=2", "at_iter": 6},
+    ])
+    caches = [r["cache"] for r in reshard_lines(log_c)]
+    assert caches == ["miss", "hit", "hit"], (
+        f"reshard-back must hit the per-layout compile cache (no new "
+        f"executable), got {caches}"
+    )
+
+    print(
+        f"reshard smoke: dp=4 -> dp=2,tp=2 at iter 2 in "
+        f"{rec['relayout_ms']}ms ({rec['leaves_moved']} leaves, "
+        f"{rec['bytes_relaid']} bytes), final weights bitwise == "
+        f"layout-B replay, reshard-back cache {caches[1:]} — no restart"
+    )
+    import shutil
+
+    shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -441,6 +441,14 @@ def train_loop(
     # recorder is off, so non-primary ranks keep their postmortem
     # context even though their stdout stays quiet
     log = _flight.tee_log(log)
+    # live-reshard control surface (parallel/reshard.py): a request
+    # file named by SPARKNET_RESHARD_REQUEST (or reshard_request.json
+    # in a supervised child's run dir) migrates the job to a new
+    # layout in place at a chunk boundary; None — zero per-iteration
+    # cost — unless configured AND this solver can reshard
+    from ..parallel import reshard as _reshard
+
+    reshard_watch = _reshard.RequestWatcher.create(solver, log=log)
     if timer is None:
         shapes = solver.train_net.blob_shapes
         data_name = "data" if "data" in shapes else next(iter(shapes), None)
@@ -515,11 +523,14 @@ def train_loop(
                     )
                     os._exit(int(rule.params.get("exit_code", 9)))
             # stop at the nearest of: next test boundary, next snapshot
-            # boundary, max_iter — so neither cadence skips the other's.
+            # boundary, a requested reshard's at_iter, max_iter — so
+            # neither cadence skips the others'.
             targets = [sp.max_iter]
             for interval in (sp.test_interval, sp.snapshot):
                 if interval:
                     targets.append((solver.iter // interval + 1) * interval)
+            if reshard_watch is not None:
+                reshard_watch.add_targets(targets, solver.iter)
             nxt = min(targets)
             prev_iter = solver.iter
             timer.update(0)  # reset window: exclude eval/snapshot time
@@ -562,6 +573,12 @@ def train_loop(
                 and (solver.iter % sp.snapshot == 0 or at_end)
             ):
                 write_snapshot()
+            # reshard AFTER the boundary's snapshot: the snapshot at
+            # the migration point carries the pre-reshard layout, so a
+            # replay from it under the new layout reproduces the
+            # resharded run bitwise (scripts/reshard_smoke.py pins it)
+            if reshard_watch is not None and not at_end:
+                reshard_watch.poll()
     done_iters = solver.iter
     dt = time.time() - t0
     log(

@@ -42,11 +42,13 @@ from .partition import (
 from .tau_controller import TauController
 from .trainer import ParallelSolver
 from . import comm, multihost, partition
+from . import reshard
 
 __all__ = [
     "comm",
     "multihost",
     "partition",
+    "reshard",
     "Layout",
     "Rule",
     "RULESETS",

@@ -606,10 +606,13 @@ def fence_once(tree):
 # --------------------------------------------------------------------------
 
 def relayout_warning(saved_specs_json: str, current: Dict[str, str],
-                     saved_layout: str = "", current_layout: str = "") -> str:
-    """One aggregated message for a resume whose snapshot carries
-    different per-leaf specs than the live layout: name the count and
-    the two layouts, not a leaf-per-line wall."""
+                     saved_layout: str = "", current_layout: str = "",
+                     event: str = "resume") -> str:
+    """One aggregated message for a relayout — name the count and the
+    two layouts, not a leaf-per-line wall.  The same migration runs on
+    two paths, and the wording names which: ``event="resume"`` (a
+    snapshot restored under a different layout) or ``event="reshard"``
+    (a live in-place migration, parallel/reshard.py)."""
     try:
         saved = json.loads(saved_specs_json)
     except (TypeError, json.JSONDecodeError):
@@ -618,9 +621,16 @@ def relayout_warning(saved_specs_json: str, current: Dict[str, str],
         k for k in current
         if k in saved and saved[k] != current[k]
     ] + [k for k in current if k not in saved]
+    head, src, dst = (
+        ("relayout on resume", "snapshot", "run")
+        if event == "resume"
+        else ("relayout (live reshard)", "old", "new")
+    )
     return (
-        f"relayout on resume: {len(changed)} of {len(current)} leaves "
-        f"re-partitioned (snapshot layout {saved_layout or 'unknown'} -> "
-        f"run layout {current_layout or 'unknown'}); weights are placed "
-        "per the RUN's rule table — numerics match to reduction order"
+        f"{head}: {len(changed)} of {len(current)} leaves "
+        f"re-partitioned ({src} layout {saved_layout or 'unknown'} -> "
+        f"{dst} layout {current_layout or 'unknown'}); weights are placed "
+        "per the new rule table bitwise-unchanged — numerics of further "
+        "training match to reduction order (the same in-place migration "
+        "on either path; docs/PARALLELISM.md \"Live resharding\")"
     )

@@ -1,0 +1,472 @@
+"""Live elastic resharding — migrate a running job between layouts.
+
+PR 10 made a parallel layout pure data (an ordered regex rule table
+compiled into per-leaf ``NamedSharding`` trees), and snapshots already
+relayout-on-resume — but changing layout still cost a full process
+restart (backend re-init, model rebuild, recompile).  This module is
+the finishing move, the TensorFlow paper's dynamic re-placement of a
+running dataflow (PAPERS.md, arXiv:1605.08695) applied to our
+one-compiled-step world: :func:`reshard` recomputes the rule-table
+trees for the new mesh, ``jax.device_put``\\ s the live params / BN
+state / optimizer slots across (pure data movement — BITWISE
+preserving, the same trick as relayout-on-resume, now without the
+restart), re-jits through
+:func:`~sparknet_tpu.parallel.partition.make_sharded_train_step`, and
+atomically swaps the solver's compiled step the way the serve tier's
+hot-swap exchanges weight pointers.
+
+Compile-cache warmth: steps are cached per layout inside the solver,
+keyed by the serve tier's ``net_fingerprint`` (which already folds the
+layout fingerprint in) — resharding back to a layout seen earlier this
+run reuses the SAME jitted callable, so no retrace, no recompile, and
+when jax's persistent compilation cache is configured the on-disk
+entries can never alias across layouts either.
+
+Triggers (docs/PARALLELISM.md "Live resharding"):
+
+- **explicit** — a request file (``SPARKNET_RESHARD_REQUEST``, or
+  ``reshard_request.json`` in the supervisor's run dir for supervised
+  children) polled by the training loop at chunk boundaries, mirroring
+  how ``--auto-resume`` is driven today: the operator (or the
+  supervisor) writes ``{"layout": "dp=2,tp=2", "at_iter": 200}`` and
+  the job migrates in place at that boundary
+  (:class:`RequestWatcher`);
+- **degrade** — the supervisor's rank-blame path generalizes from
+  "dp width−1" to :func:`degrade_layout`: the best rule-table entry
+  for the surviving mesh (model-parallel axes preserved while they
+  divide the surviving device budget);
+- **advisory** — the tau controller raises a ``layout`` advisory when
+  a local-SGD job stays sync-bound at ``SPARKNET_TAU_MAX`` (τ can't
+  widen further; a different table entry is the remaining lever).
+
+What stays restart-only: τ-local SGD and bucketed/compressed sync comm
+(explicit dp-only ``shard_map`` programs), and multi-host width changes
+(the supervisor relaunch path owns those — a live migration would need
+every process to repartition its addressable shards in lockstep).
+
+Imports are lazy throughout: the supervisor consumes
+:func:`degrade_layout` without paying a jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+RESHARD_PHASE = "reshard"
+REQUEST_ENV = "SPARKNET_RESHARD_REQUEST"
+REQUEST_NAME = "reshard_request.json"
+
+
+class ReshardError(ValueError):
+    """A live reshard this solver/layout combination cannot perform —
+    the message names the restart-path alternative."""
+
+
+# ---------------------------------------------------------------------------
+# the migration
+# ---------------------------------------------------------------------------
+
+def _axes_str(layout) -> str:
+    return ",".join(f"{a}={s}" for a, s in layout.axes)
+
+
+def _check_reshardable(solver) -> None:
+    from .trainer import ParallelSolver
+
+    if not isinstance(solver, ParallelSolver) or solver.layout is None:
+        raise ReshardError(
+            "live resharding needs a ParallelSolver with a --layout "
+            "(the unified rule-table path, docs/PARALLELISM.md); this "
+            "solver has no layout to migrate from"
+        )
+    if solver.mode != "sync":
+        raise ReshardError(
+            "live resharding is sync-mode only: τ-local SGD (--parallel "
+            "local, --tau auto included) runs explicit dp-only shard_map "
+            "round programs that cannot be re-partitioned in place — "
+            "snapshot and restart with --parallel sync --layout ..., or "
+            "let relayout-on-resume migrate the snapshot"
+        )
+    if solver._plan is None:
+        raise ReshardError(
+            "live resharding needs the unified compile path; bucketed/"
+            "compressed sync comm (--grad-compress / SPARKNET_COMM="
+            "bucketed) is an explicit dp shard_map program — drop it to "
+            "reshard live"
+        )
+    import jax
+
+    if jax.process_count() > 1:
+        raise ReshardError(
+            "live resharding is single-process only: a multi-host width "
+            "change must go through the supervisor's degrade/relaunch "
+            "path (every process repartitions on relaunch; docs/"
+            "MULTIHOST.md)"
+        )
+
+
+def resolve_layout(solver, new_layout):
+    """An axes string (``"dp=2,tp=2"``) inherits the running layout's
+    rule table / validation / batch axis — the table IS the policy, the
+    mesh shape is what changes; a full :class:`Layout` passes through."""
+    from . import partition
+
+    if isinstance(new_layout, partition.Layout):
+        return new_layout
+    base = solver.layout
+    return partition.Layout(
+        axes=tuple(partition.parse_axes(str(new_layout)).items()),
+        rules=base.rules,
+        name=base.name,
+        validate=base.validate,
+        batch_axis=base.batch_axis,
+    )
+
+
+def _fingerprint(solver, layout) -> str:
+    from ..serve.compile_cache import net_fingerprint
+
+    return net_fingerprint(
+        solver.train_net, solver.params, solver.state,
+        getattr(solver.train_net, "compute_dtype", None), layout=layout,
+    )
+
+
+def _moved(old_specs: Dict[str, str], new_specs: Dict[str, str], tree):
+    """(count, bytes) of the leaves whose partition spec changed — the
+    data the migration actually relays (spec-identical leaves keep
+    their placement; ``device_put`` is free to alias them)."""
+    from . import partition
+
+    flat = dict(partition.tree_paths(tree))
+    moved = [k for k, s in new_specs.items() if old_specs.get(k) != s]
+    nbytes = sum(
+        flat[k].size * flat[k].dtype.itemsize for k in moved if k in flat
+    )
+    return len(moved), int(nbytes)
+
+
+def reshard(solver, new_layout, *, reason: str = "explicit") -> Dict[str, Any]:
+    """Migrate a running :class:`ParallelSolver` to ``new_layout`` in
+    place: recompute the rule-table trees for the new mesh, ``device_put``
+    params / net state / optimizer slots across (bitwise-preserving),
+    and atomically swap the compiled train/eval steps.  Returns the
+    machine-readable migration record (the ``reshard:`` line's payload).
+
+    The per-layout step cache keeps reshards back to layouts seen
+    earlier this run compile-free (``record["cache"] == "hit"``).
+    """
+    import jax
+
+    from . import partition
+    from ..telemetry.registry import REGISTRY
+    from ..telemetry import timeline as _ttl
+
+    _check_reshardable(solver)
+    layout = resolve_layout(solver, new_layout)
+    old_layout, old_plan = solver.layout, solver._plan
+    fp = _fingerprint(solver, layout)
+
+    cache = getattr(solver, "_reshard_cache", None)
+    if cache is None:
+        cache = solver._reshard_cache = {}
+    # seed with the running layout so A -> B -> A is a hit on the way back
+    cache.setdefault(_fingerprint(solver, old_layout), {
+        "layout": old_layout, "plan": old_plan,
+        "train_step": solver._train_step, "eval_step": solver._eval_step,
+    })
+
+    entry = cache.get(fp)
+    cache_hit = entry is not None
+    if entry is None:
+        mesh = layout.mesh()
+        plan = partition.make_plan(
+            layout, solver.params, solver.state, solver.sp, mesh=mesh
+        )
+        ndp = mesh.shape.get(layout.batch_axis, 1)
+        for which, xnet in (
+            ("train", solver.train_net), ("test", solver.test_net)
+        ):
+            for name in xnet.input_names:
+                bs = xnet.blob_shapes[name][0]
+                if bs % ndp:
+                    raise ReshardError(
+                        f"{which} input {name!r}: batch {bs} not divisible "
+                        f"by {layout.batch_axis}={ndp} in the requested "
+                        f"layout {_axes_str(layout)}"
+                    )
+        entry = cache[fp] = {
+            "layout": layout,
+            "plan": plan,
+            "train_step": partition.make_sharded_train_step(
+                solver.train_net, solver.sp, plan
+            ),
+            "eval_step": partition.make_sharded_eval_step(
+                solver.test_net, plan
+            ),
+        }
+    layout, plan = entry["layout"], entry["plan"]
+
+    # migration timing rides the telemetry timeline (one `reshard`
+    # phase); an uninstrumented solver gets a private fenced timeline
+    # so the record still carries an honest cost without ad-hoc clocks
+    tl = solver.timeline if solver.timeline.enabled else _ttl.Timeline(
+        fence=True
+    )
+    before_s = tl.phase_seconds().get(RESHARD_PHASE, 0.0)
+    with tl.phase(RESHARD_PHASE):
+        params = partition.place(solver.params, plan.params_sh)
+        state = partition.place(solver.state, plan.state_sh)
+        opt_state = (
+            partition.place(solver.opt_state, plan.opt_sh)
+            if solver.opt_state else solver.opt_state
+        )
+        # fence inside the phase: the migration cost is the data
+        # movement, not whenever the next step happens to block
+        jax.block_until_ready((params, state, opt_state))
+    cost_s = tl.phase_seconds().get(RESHARD_PHASE, 0.0) - before_s
+
+    leaves, nbytes = _moved(old_plan.specs, plan.specs, params)
+    n_slots = len(plan.opt_sh)
+    state_specs_old = partition.specs_record(
+        state, old_layout.rules, old_plan.mesh
+    )
+    state_specs_new = partition.specs_record(state, layout.rules, plan.mesh)
+    st_leaves, st_bytes = _moved(state_specs_old, state_specs_new, state)
+
+    # ---- the atomic swap: every reference flips after the new trees
+    # exist, so a failure above leaves the solver running under layout A
+    solver.params, solver.state, solver.opt_state = params, state, opt_state
+    solver._train_step = entry["train_step"]
+    solver._eval_step = entry["eval_step"]
+    solver._plan = plan
+    solver.layout = layout
+    solver.mesh = plan.mesh
+    solver._eval_sharding = plan.batch_eval_sh
+    solver._train_sharding = plan.batch_train_sh
+    # snapshots taken from here on must carry the NEW layout + specs,
+    # or a later --auto-resume would silently relayout backwards
+    solver._record_layout_env()
+
+    record = {
+        "from": _axes_str(old_layout),
+        "to": _axes_str(layout),
+        "from_mesh": dict(old_plan.mesh.shape),
+        "to_mesh": dict(plan.mesh.shape),
+        "reason": reason,
+        "relayout_ms": round(cost_s * 1e3, 3),
+        "leaves_moved": leaves * (1 + n_slots) + st_leaves,
+        "bytes_relaid": nbytes * (1 + n_slots) + st_bytes,
+        "cache": "hit" if cache_hit else "miss",
+        "fingerprint": fp,
+    }
+    REGISTRY.counter("reshard_events", **{
+        "from": record["from"], "to": record["to"], "reason": reason,
+    }).inc()
+    return record
+
+
+# ---------------------------------------------------------------------------
+# degrade: the best table entry for a surviving mesh (supervisor path)
+# ---------------------------------------------------------------------------
+
+def degrade_layout(spec: str, full_width: int, new_width: int) -> str:
+    """The supervisor's elastic generalization: given the job's
+    declared layout axes and a width change (process count
+    ``full_width`` -> ``new_width``), return the best table entry for
+    the surviving mesh — model-parallel axes are preserved while their
+    product divides the surviving device budget, halving the largest
+    one until it fits, and the batch ("dp") axis absorbs the rest.
+    Pure stdlib (the supervisor must stay importable without jax).
+
+    ``degrade_layout("dp=4", 4, 3) == "dp=3"``;
+    ``degrade_layout("dp=2,tp=4", 8, 4) == "dp=1,tp=4"`` (the tp block
+    survives); scale-up back to ``full_width`` restores the original.
+    """
+    axes: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    if new_width >= full_width or any(s < 0 for s in axes.values()):
+        # scale-up restores the declared layout; a -1 axis already
+        # means "all remaining devices" and resolves at mesh build
+        return ",".join(f"{a}={s}" for a, s in axes.items())
+    total = 1
+    for s in axes.values():
+        total *= s
+    budget = max(1, total * new_width // full_width)
+    model = {a: s for a, s in axes.items() if a != "dp" and s > 1}
+    while model:
+        prod = 1
+        for s in model.values():
+            prod *= s
+        if budget % prod == 0 and prod <= budget:
+            break
+        widest = max(model, key=lambda a: model[a])
+        model[widest] //= 2
+        if model[widest] <= 1:
+            del model[widest]
+    prod = 1
+    for s in model.values():
+        prod *= s
+    out = {"dp": max(1, budget // prod)}
+    out.update(model)
+    # keep the declared axis order where it survives
+    ordered = [a for a in axes if a in out] + [
+        a for a in out if a not in axes
+    ]
+    return ",".join(f"{a}={out[a]}" for a in ordered)
+
+
+# ---------------------------------------------------------------------------
+# the explicit control surface: a request file polled by the train loop
+# ---------------------------------------------------------------------------
+
+def request_path() -> Optional[str]:
+    """Where the training loop looks for reshard requests:
+    ``SPARKNET_RESHARD_REQUEST`` names the file explicitly; a
+    supervised child (``SPARKNET_SUPERVISE_DIR``) watches
+    ``reshard_request.json`` in its run dir — the supervisor-side half
+    of the control surface."""
+    explicit = os.environ.get(REQUEST_ENV, "").strip()
+    if explicit:
+        return explicit
+    run_dir = os.environ.get("SPARKNET_SUPERVISE_DIR", "").strip()
+    if run_dir:
+        return os.path.join(run_dir, REQUEST_NAME)
+    return None
+
+
+class RequestWatcher:
+    """Polls the request file at training-chunk boundaries and fires
+    :func:`reshard` in place.  A request is one JSON object (or a list
+    of them): ``{"layout": "dp=2,tp=2", "at_iter": 200}`` — ``at_iter``
+    (optional) delays the migration to that iteration boundary and
+    joins the loop's chunk targets so the boundary actually lands
+    there.  Consumed requests append their migration record (or error)
+    to ``<path>.log`` as JSON lines, so the requester can read the
+    outcome without scraping stdout."""
+
+    def __init__(self, solver, path: str, log=print):
+        self.solver = solver
+        self.path = path
+        self.log = log
+        self._mtime: Optional[float] = None
+        self._requests: List[Dict[str, Any]] = []
+        self._done: set = set()
+        self._warned_bad = False
+
+    @classmethod
+    def create(cls, solver, log=print) -> Optional["RequestWatcher"]:
+        """The train loop's constructor: None (zero per-iteration cost)
+        unless a request path is configured AND this solver can
+        reshard.  An explicit ``SPARKNET_RESHARD_REQUEST`` on a solver
+        that cannot reshard warns once instead of silently ignoring the
+        surface."""
+        path = request_path()
+        if not path:
+            return None
+        try:
+            _check_reshardable(solver)
+        except ReshardError as e:
+            if os.environ.get(REQUEST_ENV, "").strip():
+                log(f"WARNING: {REQUEST_ENV} is set but this run cannot "
+                    f"reshard live: {e}")
+            return None
+        return cls(solver, path, log=log)
+
+    # -- request file ----------------------------------------------------
+    def _key(self, req: Dict[str, Any]) -> str:
+        return json.dumps(req, sort_keys=True)
+
+    def _load(self) -> None:
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            self._requests = []
+            return
+        if mtime == self._mtime:
+            return
+        self._mtime = mtime
+        try:
+            with open(self.path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            # a torn half-written request file is retried on the next
+            # poll (the writer may still be mid-rename); warn once
+            if not self._warned_bad:
+                self._warned_bad = True
+                self.log(f"WARNING: unreadable reshard request "
+                         f"{self.path}: {e}")
+            self._mtime = None
+            return
+        self._warned_bad = False
+        reqs = doc if isinstance(doc, list) else [doc]
+        self._requests = [r for r in reqs if isinstance(r, dict)]
+
+    def _pending(self) -> List[Dict[str, Any]]:
+        self._load()
+        return [r for r in self._requests if self._key(r) not in self._done]
+
+    # -- train-loop hooks ------------------------------------------------
+    def add_targets(self, targets: List[int], cur_iter: int) -> None:
+        """Make requested ``at_iter`` boundaries chunk targets, so the
+        loop stops exactly there instead of at the next test/snapshot
+        cadence."""
+        for r in self._pending():
+            at = int(r.get("at_iter", 0) or 0)
+            if at > cur_iter:
+                targets.append(at)
+
+    def poll(self) -> List[Dict[str, Any]]:
+        """Fire every pending request whose boundary has arrived;
+        returns the migration records."""
+        out: List[Dict[str, Any]] = []
+        for req in self._pending():
+            if int(req.get("at_iter", 0) or 0) > self.solver.iter:
+                continue
+            self._done.add(self._key(req))
+            record = self._fire(req)
+            if record is not None:
+                out.append(record)
+        return out
+
+    def _fire(self, req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        from . import partition
+
+        target = req.get("layout")
+        old_specs = dict(self.solver._plan.specs)
+        old_name = _axes_str(self.solver.layout)
+        try:
+            if not target:
+                raise ReshardError(
+                    f"reshard request without a 'layout' key: {req}"
+                )
+            record = reshard(
+                self.solver, str(target),
+                reason=str(req.get("reason", "request")),
+            )
+        except (ReshardError, ValueError) as e:
+            record = {"error": str(e), "request": req}
+            self.log(f"WARNING: reshard request failed: {e}")
+        else:
+            record["at_iter"] = self.solver.iter
+            self.log(f"reshard: {json.dumps(record)}")
+            # the aggregated relayout notice, worded for the live path
+            self.log(partition.relayout_warning(
+                json.dumps(old_specs), self.solver._plan.specs,
+                saved_layout=old_name,
+                current_layout=_axes_str(self.solver.layout),
+                event="reshard",
+            ))
+        try:
+            with open(self.path + ".log", "a") as fh:
+                fh.write(json.dumps(record) + "\n")
+        except OSError:
+            pass
+        return None if "error" in record else record

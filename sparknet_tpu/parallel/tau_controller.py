@@ -83,6 +83,14 @@ class TauController:
         self._cooldown = 0
         self._loss_ema: Optional[float] = None
         self._round = 0
+        # layout advisory (ISSUE 14): a job that stays sync-bound at
+        # tau_max has exhausted the τ lever — after this many
+        # consecutive such rounds the controller raises a `layout`
+        # advisory pointing at live resharding (parallel/reshard.py)
+        self.layout_advisory_rounds = max(
+            1, _env_int("SPARKNET_TAU_LAYOUT_ADVISORY_ROUNDS", 2)
+        )
+        self._syncbound_at_max = 0
         self.decisions: List[Dict[str, Any]] = []
         from ..telemetry import REGISTRY
 
@@ -138,6 +146,39 @@ class TauController:
                 + (" (straggler advisory active)" if straggler else "")
             )
             self._cooldown = self.cooldown_rounds
+        # sync-bound with τ pinned at tau_max: widening is no longer an
+        # option, so the remaining lever is the LAYOUT.  After
+        # `layout_advisory_rounds` consecutive such rounds, raise a
+        # `layout` advisory (same board as straggler) naming live
+        # resharding.  Single-process only — the caller passes
+        # ``advisories=None`` under multi-host (τ and any layout move
+        # must stay rank-identical, same caveat as straggler
+        # consumption), which also gates the raise.
+        layout_advisory = False
+        if share > widen_share and self.tau >= self.tau_max:
+            self._syncbound_at_max += 1
+            if (
+                self._syncbound_at_max >= self.layout_advisory_rounds
+                and advisories is not None
+            ):
+                layout_advisory = True
+                from ..telemetry import anomaly as _anomaly
+
+                _anomaly.fire(
+                    "layout",
+                    key="tau_max",
+                    tau=self.tau,
+                    sync_share=round(share, 4),
+                    rounds=self._syncbound_at_max,
+                    suggestion=(
+                        "sync-bound at SPARKNET_TAU_MAX — τ cannot "
+                        "widen further; consider a live reshard to a "
+                        "different layout table entry "
+                        "(parallel/reshard.py, docs/PARALLELISM.md)"
+                    ),
+                )
+        elif share <= widen_share:
+            self._syncbound_at_max = 0
         # EMA after the divergence test: the test compares THIS round
         # against the trajectory before it
         self._loss_ema = (
@@ -161,6 +202,8 @@ class TauController:
         }
         if straggler:
             decision["straggler_advisory"] = True
+        if layout_advisory:
+            decision["layout_advisory"] = True
         self.decisions.append(decision)
         return self.tau
 
@@ -177,6 +220,9 @@ class TauController:
             "widened": sum(1 for d in self.decisions if d["action"] == "widen"),
             "narrowed": sum(
                 1 for d in self.decisions if d["action"] == "narrow"
+            ),
+            "layout_advisories": sum(
+                1 for d in self.decisions if d.get("layout_advisory")
             ),
             "tau_trajectory": taus,
             "decisions": self.decisions,

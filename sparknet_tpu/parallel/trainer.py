@@ -162,18 +162,10 @@ class ParallelSolver(Solver):
                     mesh=self.mesh,
                 )
             # snapshots carry the layout + per-leaf specs so a resume
-            # under a different layout warns and relayouts explicitly
-            self.env_meta["layout"] = partition_mod.layout_to_json(
-                self.layout
-            )
-            import json as _json
-
-            self.env_meta["param_specs"] = _json.dumps(
-                partition_mod.specs_record(
-                    self.params, self.layout.rules, self.mesh
-                ),
-                sort_keys=True,
-            )
+            # under a different layout warns and relayouts explicitly;
+            # a live reshard re-records both (reshard.py) so snapshots
+            # taken after the migration carry the NEW layout
+            self._record_layout_env()
         if self._plan is not None:
             self.params = partition_mod.place(
                 self.params, self._plan.params_sh
@@ -264,6 +256,31 @@ class ParallelSolver(Solver):
             self.timeline.start()
 
     # ------------------------------------------------------------------
+    def _record_layout_env(self) -> None:
+        """(Re)write the snapshot env's layout + per-leaf specs from the
+        solver's CURRENT layout — called at construction and again by
+        every live reshard, so a snapshot always resumes into the
+        layout the job was actually running."""
+        if self.layout is None:
+            return
+        import json as _json
+
+        self.env_meta["layout"] = partition_mod.layout_to_json(self.layout)
+        specs = (
+            self._plan.specs if self._plan is not None
+            else partition_mod.specs_record(
+                self.params, self.layout.rules, self.mesh
+            )
+        )
+        self.env_meta["param_specs"] = _json.dumps(specs, sort_keys=True)
+
+    def reshard(self, new_layout, *, reason: str = "explicit"):
+        """Migrate this running solver to ``new_layout`` in place —
+        see :func:`sparknet_tpu.parallel.reshard.reshard`."""
+        from . import reshard as reshard_mod
+
+        return reshard_mod.reshard(self, new_layout, reason=reason)
+
     def _dp_sharding(self):
         return jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(self.dp_axis)

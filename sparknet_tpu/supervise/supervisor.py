@@ -86,6 +86,36 @@ def strip_flag(argv: Sequence[str], flag: str, has_value: bool = False) -> List[
     return out
 
 
+def flag_value(argv: Sequence[str], flag: str) -> Optional[str]:
+    """The value of ``flag x`` / ``flag=x`` in argv, or None."""
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a[len(flag) + 1:]
+    return None
+
+
+def set_flag_value(argv: Sequence[str], flag: str, value: str) -> List[str]:
+    """Replace ``flag``'s value in argv (both spellings); argv is
+    returned untouched when the flag is absent."""
+    out: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == flag and i + 1 < len(argv):
+            out.extend([flag, value])
+            i += 2
+            continue
+        if a.startswith(flag + "="):
+            out.append(f"{flag}={value}")
+            i += 1
+            continue
+        out.append(a)
+        i += 1
+    return out
+
+
 class Supervisor:
     """Owns the relaunch loop for one training job.
 
@@ -121,6 +151,11 @@ class Supervisor:
         self.cfg = config or Config()
         self.auto_resume = auto_resume
         self._base_env = dict(os.environ if env is None else env)
+        # elastic degrade, generalized (ISSUE 14): a job that declares
+        # --layout relaunches with the best rule-table entry for the
+        # surviving mesh instead of bare dp width−1 — computed from the
+        # ORIGINAL declaration each time, so scale-up restores it
+        self._orig_layout = flag_value(self.argv, "--layout")
         self.report: Dict[str, Any] = {
             "version": 1,
             "argv": self.argv,
@@ -321,6 +356,29 @@ class Supervisor:
                 return rank
         return None
 
+    def _apply_elastic_layout(self, width: int, entry: Dict[str, Any]) -> None:
+        """The width−1 degrade, generalized to the layout table: when
+        the job declares ``--layout``, relaunch with the best table
+        entry for the surviving mesh (``reshard.degrade_layout`` —
+        model-parallel axes preserved while they divide the surviving
+        device budget).  Scale-up recomputes from the original
+        declaration, restoring it at full width."""
+        if not self._orig_layout:
+            return
+        from ..parallel.reshard import degrade_layout
+
+        new_spec = degrade_layout(self._orig_layout, self.num_procs, width)
+        cur = flag_value(self.argv, "--layout")
+        if new_spec == cur:
+            return
+        self.argv = set_flag_value(self.argv, "--layout", new_spec)
+        entry["relayout"] = {"from": cur, "to": new_spec}
+        METRICS.inc("elastic_relayouts")
+        _log(
+            f"elastic layout: {cur} -> {new_spec} (best table entry for "
+            f"width {width}; the relaunch relayouts on resume)"
+        )
+
     def _write_report(self) -> str:
         path = os.path.join(self.run_dir, REPORT_NAME)
         os.makedirs(self.run_dir, exist_ok=True)
@@ -444,6 +502,8 @@ class Supervisor:
             elif action == "scale_up":
                 METRICS.inc("scale_ups")
                 _log(f"scaling back up to {width} process(es)")
+            if action in ("degrade", "scale_up"):
+                self._apply_elastic_layout(width, entry)
             if self.num_procs > 1:
                 self._base_env["SPARKNET_ELASTIC_RESUME"] = (
                     "1" if width != self.num_procs else "0"

@@ -54,6 +54,7 @@ PHASES = (
     "grad_allreduce",
     "eval",
     "snapshot",
+    "reshard",  # live layout migration (parallel/reshard.py)
 )
 
 

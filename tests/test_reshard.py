@@ -1,0 +1,414 @@
+"""Live elastic resharding (parallel/reshard.py) on the 8-device
+virtual CPU mesh.
+
+Pins the ISSUE 14 contract:
+- a mid-run ``dp=4`` -> ``dp=2,tp=2`` migration preserves params, BN
+  state and optimizer slots BITWISE (``device_put`` is data movement,
+  never arithmetic) and swaps the compiled step without a restart;
+- continuing after the reshard is bitwise-equal to replaying the same
+  iterations in a fresh layout-B job restored from the reshard-point
+  snapshot (identical shardings -> identical executables), and
+  ulp-close to a job that ran in layout B from the start (PR 10's
+  cross-partitioning bar);
+- snapshots taken after the reshard carry the NEW layout + specs, so
+  an ``--auto-resume`` cannot silently relayout backwards;
+- a second reshard to a layout seen earlier this run hits the
+  per-layout step cache — the SAME jitted callable, no recompile;
+- τ-local SGD / bucketed comm / layout-less solvers are rejected with
+  a pointer, not a deep XLA error;
+- the tau controller raises a ``layout`` advisory when a job stays
+  sync-bound at tau_max (single-process only);
+- the supervisor's degrade path rewrites ``--layout`` to the best
+  table entry for the surviving mesh.
+"""
+
+import contextlib
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sparknet_tpu.parallel import ParallelSolver, partition
+from sparknet_tpu.parallel.partition import parse_layout
+from sparknet_tpu.parallel.reshard import (
+    RequestWatcher,
+    ReshardError,
+    degrade_layout,
+    reshard,
+)
+from sparknet_tpu.proto import caffe_pb
+
+from .test_parallel import SHAPES, TINY_NET, batch, tiny_net, tiny_solver
+
+# a variant with BatchNorm so the net-state tree is non-trivial: the
+# migration must carry running stats, not just params
+BN_NET = """
+name: "tiny_bn"
+layer { name: "d" type: "Input" top: "data" top: "label" }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "xavier" } } }
+layer { name: "bn1" type: "BatchNorm" bottom: "ip1" top: "bn1" }
+layer { name: "relu1" type: "ReLU" bottom: "bn1" top: "bn1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "bn1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label" top: "loss" }
+"""
+
+
+def bn_net():
+    return caffe_pb.load_net(BN_NET, is_path=False)
+
+
+def feed_of(b):
+    def gen():
+        while True:
+            yield b
+    return gen()
+
+
+def host_tree(tree):
+    # np.array copies: on CPU device_get may alias device buffers that
+    # later steps DONATE — a view would read freed memory
+    return jax.tree_util.tree_map(lambda x: np.array(x), jax.device_get(tree))
+
+
+def assert_tree_bitwise(a, b, what=""):
+    for (ka, x), (kb, y) in zip(partition.tree_paths(a), partition.tree_paths(b)):
+        assert ka == kb
+        assert (np.asarray(x) == np.asarray(y)).all(), f"{what}:{ka}"
+
+
+def dp4_solver(net_fn=tiny_net, **kw):
+    return ParallelSolver(
+        tiny_solver(), SHAPES, net_param=net_fn(), seed=7,
+        layout=parse_layout("dp=4", rules="tp"), **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# the migration itself
+# ---------------------------------------------------------------------------
+
+def test_reshard_bitwise_preserves_params_bn_state_and_opt_slots():
+    s = dp4_solver(net_fn=bn_net)
+    s.step(feed_of(batch(0)), 3)  # BN stats + momentum slots are live
+    params0 = host_tree(s.params)
+    state0 = host_tree(s.state)
+    opt0 = host_tree(s.opt_state)
+    assert any(np.asarray(x).any() for x in jax.tree_util.tree_leaves(state0))
+
+    rec = s.reshard("dp=2,tp=2")
+
+    assert_tree_bitwise(params0, host_tree(s.params), "params")
+    assert_tree_bitwise(state0, host_tree(s.state), "state")
+    assert_tree_bitwise(opt0, host_tree(s.opt_state), "opt")
+    # the params really moved to the new table's placement
+    assert s.params["ip1"]["weight"].sharding.spec == P(None, "tp")
+    assert s.mesh.shape == {"dp": 2, "tp": 2}
+    assert s.layout_report()["mesh"] == {"dp": 2, "tp": 2}
+    assert rec["from"] == "dp=4" and rec["to"] == "dp=2,tp=2"
+    assert rec["cache"] == "miss"
+    assert rec["leaves_moved"] >= 1 and rec["bytes_relaid"] > 0
+    assert rec["relayout_ms"] >= 0.0
+    # training continues through the swapped step, in place
+    m = s.step(feed_of(batch(0)), 2)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_reshard_records_new_layout_env_for_snapshots(tmp_path):
+    """ISSUE 14 satellite: snapshots after an in-place reshard must
+    carry the NEW layout + per-leaf specs — else a later --auto-resume
+    silently relayouts backwards to layout A."""
+    s = dp4_solver()
+    s.step(feed_of(batch(1)), 2)
+    s.reshard("dp=2,tp=2")
+    assert json.loads(s.env_meta["layout"])["axes"] == [["dp", 2], ["tp", 2]]
+    assert json.loads(s.env_meta["param_specs"]) == s._plan.specs
+    s.step(feed_of(batch(1)), 1)
+    snap = str(tmp_path / "post_iter_3.solverstate.npz")
+    s.save(snap)
+
+    # resume in the resharded layout: specs match, NO relayout warning
+    b = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7,
+        layout=parse_layout("dp=2,tp=2", rules="tp"),
+    )
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        b.restore(snap)
+    assert "relayout" not in err.getvalue()
+    assert_tree_bitwise(host_tree(s.params), host_tree(b.params), "resume")
+
+
+def test_reshard_then_continue_equals_replay_and_scratch(tmp_path):
+    """Continue-training equivalence: bitwise vs a fresh layout-B job
+    restored from the reshard-point snapshot (same shardings -> same
+    executable), allclose vs a job started in layout B from scratch
+    (cross-partitioning is reduction-order/ulp, PR 10's bar)."""
+    b0 = batch(2)
+    a = dp4_solver()
+    a.step(feed_of(b0), 2)
+    snap = str(tmp_path / "a_iter_2.solverstate.npz")
+    a.save(snap)
+    a.reshard("dp=2,tp=2")
+    a.step(feed_of(b0), 3)
+
+    replay = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7,
+        layout=parse_layout("dp=2,tp=2", rules="tp"),
+    )
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        replay.restore(snap)  # relayout-on-resume, warned
+    assert "relayout on resume" in err.getvalue()
+    replay.step(feed_of(b0), 3)
+    assert_tree_bitwise(host_tree(a.params), host_tree(replay.params), "replay")
+
+    scratch = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=7,
+        layout=parse_layout("dp=2,tp=2", rules="tp"),
+    )
+    scratch.step(feed_of(b0), 5)
+    for (k, x), (_, y) in zip(
+        partition.tree_paths(host_tree(a.params)),
+        partition.tree_paths(host_tree(scratch.params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6, err_msg=k
+        )
+
+
+def test_second_reshard_to_seen_layout_hits_step_cache():
+    s = dp4_solver()
+    step_a = s._train_step
+    s.step(feed_of(batch(3)), 1)
+    rec1 = s.reshard("dp=2,tp=2")
+    assert rec1["cache"] == "miss"
+    step_b, eval_b = s._train_step, s._eval_step
+    assert step_b is not step_a
+
+    rec2 = s.reshard("dp=4")  # back to the starting layout: seeded hit
+    assert rec2["cache"] == "hit"
+    assert s._train_step is step_a
+
+    rec3 = s.reshard("dp=2,tp=2")  # seen this run: the SAME callable,
+    assert rec3["cache"] == "hit"  # so no retrace and no recompile
+    assert s._train_step is step_b and s._eval_step is eval_b
+    m = s.step(feed_of(batch(3)), 1)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_reshard_timeline_phase_and_registry_counter():
+    from sparknet_tpu.telemetry import timeline as _ttl
+    from sparknet_tpu.telemetry.registry import REGISTRY
+
+    s = dp4_solver()
+    tl = _ttl.Timeline(fence=True)
+    s.timeline = tl
+    tl.start()
+    labels = {"from": "dp=4", "to": "dp=2,tp=2", "reason": "explicit"}
+    before = REGISTRY.counter("reshard_events", **labels).snapshot()
+    rec = s.reshard("dp=2,tp=2")
+    assert tl.phase_seconds().get("reshard", 0.0) > 0.0
+    assert "reshard" in _ttl.PHASES
+    after = REGISTRY.counter("reshard_events", **labels).snapshot()
+    assert after == before + 1
+    assert rec["relayout_ms"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# rejections: the comm path stays dp-only
+# ---------------------------------------------------------------------------
+
+def test_reshard_rejects_local_sgd_with_pointer():
+    s = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+        layout=parse_layout("dp=8"), mode="local", tau=2,
+    )
+    with pytest.raises(ReshardError, match="sync"):
+        reshard(s, "dp=2,tp=2")
+    # --tau auto rides the same local-SGD path: same rejection
+    s2 = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+        layout=parse_layout("dp=8"), mode="local", tau="auto",
+    )
+    with pytest.raises(ReshardError, match="shard_map|sync"):
+        reshard(s2, "dp=4")
+
+
+def test_reshard_rejects_bucketed_sync_and_layoutless():
+    from sparknet_tpu.parallel import CommConfig, make_mesh
+
+    s = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+        layout=parse_layout("dp=8"),
+        comm_config=CommConfig(mode="bucketed"),
+    )
+    with pytest.raises(ReshardError, match="grad-compress|bucketed"):
+        reshard(s, "dp=4")
+    s2 = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+        mesh=make_mesh(), mode="sync",
+    )
+    with pytest.raises(ReshardError, match="layout"):
+        reshard(s2, "dp=4")
+
+
+def test_reshard_rejects_indivisible_batch_and_stays_usable():
+    s = dp4_solver()
+    with pytest.raises(ReshardError, match="not divisible"):
+        s.reshard("dp=3")  # 16 % 3
+    # rejected BEFORE any state moved: the solver still runs layout A
+    assert s.mesh.shape == {"dp": 4}
+    m = s.step(feed_of(batch(4)), 1)
+    assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# triggers
+# ---------------------------------------------------------------------------
+
+def test_tau_controller_layout_advisory_at_tau_max():
+    from sparknet_tpu.parallel.tau_controller import TauController
+    from sparknet_tpu.telemetry import anomaly
+
+    anomaly.clear()
+    try:
+        tc = TauController(tau=4, tau_min=1, tau_max=4, widen_share=0.25)
+        assert tc.layout_advisory_rounds == 2
+        # sync-bound rounds at tau_max: τ cannot widen, advisory fires
+        # after the streak (advisories=[] == the single-process hook)
+        tc.observe_round(round_s=1.0, sync_s=0.6, loss=1.0, advisories=[])
+        assert not anomaly.active("layout")
+        tc.observe_round(round_s=1.0, sync_s=0.6, loss=1.0, advisories=[])
+        (adv,) = anomaly.active("layout")
+        assert "reshard" in adv["suggestion"]
+        assert tc.decisions[-1]["layout_advisory"] is True
+        assert tc.snapshot()["layout_advisories"] == 1
+        # a non-sync-bound round resets the streak
+        tc.observe_round(round_s=1.0, sync_s=0.0, loss=1.0, advisories=[])
+        assert tc._syncbound_at_max == 0
+    finally:
+        anomaly.clear()
+
+
+def test_tau_controller_layout_advisory_multihost_gated():
+    from sparknet_tpu.parallel.tau_controller import TauController
+    from sparknet_tpu.telemetry import anomaly
+
+    anomaly.clear()
+    try:
+        tc = TauController(tau=4, tau_min=1, tau_max=4, widen_share=0.25)
+        for _ in range(4):  # advisories=None == the multi-host caller
+            tc.observe_round(round_s=1.0, sync_s=0.6, loss=1.0,
+                             advisories=None)
+        assert not anomaly.active("layout")
+        assert not any(d.get("layout_advisory") for d in tc.decisions)
+    finally:
+        anomaly.clear()
+
+
+def test_degrade_layout_best_table_entry():
+    # bare dp degrades like the old width-1 path
+    assert degrade_layout("dp=4", 4, 3) == "dp=3"
+    # model axes survive while they divide the surviving budget
+    assert degrade_layout("dp=2,tp=4", 8, 4) == "dp=1,tp=4"
+    assert degrade_layout("dp=4,tp=2", 8, 6) == "dp=3,tp=2"
+    # ... and halve away when they don't
+    assert degrade_layout("dp=2,tp=2", 4, 3) == "dp=3"
+    assert degrade_layout("dp=4,tp=2", 8, 7) == "dp=7"
+    # scale-up restores the declared layout; -1 resolves at mesh build
+    assert degrade_layout("dp=2,tp=2", 4, 4) == "dp=2,tp=2"
+    assert degrade_layout("dp=-1", 4, 3) == "dp=-1"
+
+
+def test_supervisor_degrade_rewrites_layout_flag():
+    from sparknet_tpu.supervise.supervisor import (
+        Supervisor, flag_value, set_flag_value,
+    )
+
+    argv = ["python", "-m", "x", "--layout=dp=2,tp=2", "--synthetic"]
+    sup = Supervisor(argv, num_procs=4, run_dir=".")
+    assert sup._orig_layout == "dp=2,tp=2"
+    entry = {}
+    sup._apply_elastic_layout(3, entry)
+    assert flag_value(sup.argv, "--layout") == "dp=3"
+    assert entry["relayout"] == {"from": "dp=2,tp=2", "to": "dp=3"}
+    # scale-up back to full width restores the original declaration
+    entry2 = {}
+    sup._apply_elastic_layout(4, entry2)
+    assert flag_value(sup.argv, "--layout") == "dp=2,tp=2"
+    # a job without --layout is untouched (the old width-1 behavior)
+    sup2 = Supervisor(["python", "-m", "x"], num_procs=4, run_dir=".")
+    e = {}
+    sup2._apply_elastic_layout(3, e)
+    assert "relayout" not in e
+    # both flag spellings rewrite
+    assert set_flag_value(["--layout", "dp=4"], "--layout", "dp=3") == [
+        "--layout", "dp=3",
+    ]
+
+
+def test_request_watcher_fires_at_iter_boundary(tmp_path):
+    req_path = str(tmp_path / "reshard_request.json")
+    with open(req_path, "w") as fh:
+        json.dump([{"layout": "dp=2,tp=2", "at_iter": 2}], fh)
+    s = dp4_solver()
+    lines = []
+    w = RequestWatcher(s, req_path, log=lines.append)
+    targets = [100]
+    w.add_targets(targets, 0)
+    assert 2 in targets  # the boundary joins the loop's chunk targets
+    assert w.poll() == []  # iter 0 < at_iter 2: not yet
+    s.step(feed_of(batch(5)), 2)
+    (rec,) = w.poll()
+    assert rec["to"] == "dp=2,tp=2" and rec["at_iter"] == 2
+    assert s.mesh.shape == {"dp": 2, "tp": 2}
+    assert any(l.startswith("reshard: ") for l in lines)
+    assert any("relayout (live reshard)" in l for l in lines)
+    # consumed: polling again is a no-op
+    assert w.poll() == []
+    # the outcome landed in the request log for the requester
+    with open(req_path + ".log") as fh:
+        logged = [json.loads(l) for l in fh]
+    assert logged[-1]["to"] == "dp=2,tp=2"
+
+
+def test_request_watcher_bad_requests_do_not_kill_the_loop(tmp_path):
+    req_path = str(tmp_path / "req.json")
+    with open(req_path, "w") as fh:
+        fh.write("{ torn json")
+    s = dp4_solver()
+    lines = []
+    w = RequestWatcher(s, req_path, log=lines.append)
+    assert w.poll() == []  # unreadable: warned, retried next poll
+    assert any("unreadable" in l for l in lines)
+    with open(req_path, "w") as fh:
+        json.dump({"layout": "dp=3"}, fh)  # indivisible batch
+    assert w.poll() == []
+    assert any("reshard request failed" in l for l in lines)
+    assert s.mesh.shape == {"dp": 4}  # untouched, still training
+    with open(req_path + ".log") as fh:
+        assert "error" in json.loads(fh.readlines()[-1])
+
+
+def test_request_watcher_create_gates_on_reshardable(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARKNET_RESHARD_REQUEST", str(tmp_path / "r.json"))
+    lines = []
+    s = ParallelSolver(
+        tiny_solver(), SHAPES, net_param=tiny_net(), seed=0,
+        layout=parse_layout("dp=8"), mode="local", tau=2,
+    )
+    assert RequestWatcher.create(s, log=lines.append) is None
+    assert any("cannot reshard" in l for l in lines)
+    s2 = dp4_solver()
+    assert RequestWatcher.create(s2, log=lines.append) is not None
+    monkeypatch.delenv("SPARKNET_RESHARD_REQUEST")
+    assert RequestWatcher.create(s2, log=lines.append) is None
