@@ -715,6 +715,16 @@ def bench_serving_tier(platform: str) -> dict:
     3. **Warm-restart warmup**: the respawned replica boots against
        the compile cache its predecessor populated — warmup_s cold vs
        warm (acceptance: >= 30% cut).
+    4. **Autoscale + admission vs static across a 10x spike**
+       (ISSUE 16): the same seeded open-loop spike script — identical
+       arrival clock — against a static 1-replica char-rnn tier and an
+       elastic one (floor 1, ceiling 2, per-class admission).  The
+       elastic arm's interactive p99-within-SLO fraction is floored
+       and its failed/session-failed counts zero-gated by bench_diff;
+       the static arm's collapse and the gap are the evidence.  A
+       session born before the spike must survive the full
+       scale-up/scale-down arc bit-identically
+       (``autoscale_sessions_preserved``).
 
     All numbers are CPU-meaningful: latency ratios and warmup cuts,
     not absolute throughput."""
@@ -916,6 +926,179 @@ def bench_serving_tier(platform: str) -> dict:
             proc.kill()
         proc = None
 
+        # ---- arm 4 (ISSUE 16): autoscale + admission vs a static tier
+        # across the SAME seeded 10x open-loop spike — identical
+        # arrival clock in both arms.  The char-rnn net so the spike
+        # carries stateful sessions; the elastic tier runs a 50ms
+        # control budget under the 400ms client SLO (the router
+        # measures after its own ingress queue — docs/SERVING.md
+        # "two SLOs").  The static arm is EXPECTED to fail: its shed
+        # and failed counts are the evidence, only the elastic arm's
+        # are gated.
+        from sparknet_tpu.serve.loadgen import run_open_loadgen
+
+        rnn = os.path.join(zoo, "char_rnn_deploy.prototxt")
+        slo_ms = 400.0
+        batch_prefix = 32
+        auto_env = dict(child_env)
+        auto_env.update({
+            "SPARKNET_SLO_P99_MS": "50",
+            "SPARKNET_SLO_FAST_S": "2",
+            "SPARKNET_SLO_SLOW_S": "12",
+            "SPARKNET_AUTOSCALE_INTERVAL_S": "0.25",
+            "SPARKNET_AUTOSCALE_WINDOW_S": "2",
+            "SPARKNET_AUTOSCALE_UP_LOOKS": "2",
+            "SPARKNET_AUTOSCALE_UP_COOLDOWN_S": "2",
+            "SPARKNET_AUTOSCALE_DOWN_LOOKS": "12",
+            "SPARKNET_AUTOSCALE_DOWN_COOLDOWN_S": "20",
+            "SPARKNET_AUTOSCALE_DOWN_FRAC": "0.9",
+            "SPARKNET_AUTOSCALE_DRAIN_TIMEOUT_S": "15",
+            "SPARKNET_ADMIT_OUTSTANDING": "4",
+            "SPARKNET_ADMIT_HARD_FACTOR": "8",
+        })
+
+        def _boot_rnn(extra, env2, tag):
+            pf = os.path.join(tmp, f"router_{tag}.json")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "sparknet_tpu.tools.serve",
+                 "--model", rnn, "--replicas", "1",
+                 "--port", "0", "--buckets", "1",
+                 "--portfile", pf,
+                 "--run-dir", os.path.join(tmp, f"run_{tag}"),
+                 "--compile-cache", cache_root] + extra,
+                cwd=_HERE, env=env2)
+            dl = time.time() + 600
+            while not os.path.exists(pf):
+                if p.poll() is not None or time.time() > dl:
+                    raise RuntimeError(f"{tag} tier failed to start")
+                time.sleep(0.2)
+            d = json.load(open(pf))
+            c = Client(d["host"], d["port"], timeout=60, retries=4)
+            while True:
+                try:
+                    _, m = c.metrics()
+                    if m.get("replicas_healthy", 0) >= 1:
+                        break
+                except Exception:
+                    pass
+                if time.time() > dl:
+                    raise RuntimeError(f"{tag} replica never healthy")
+                time.sleep(0.3)
+            return p, d, c
+
+        def _stop_rnn(p):
+            p.send_signal(signal.SIGINT)
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+        spike_arms = {}
+        probe = [i % 96 for i in range(batch_prefix)]
+        p, d, c = _boot_rnn([], child_env, "static")
+        try:
+            # capacity probe with the batch shape, then spike at
+            # peak = 10 x base = 2.5 x measured sequential capacity
+            for _ in range(3):
+                c.generate(probe, steps=1)
+            t0 = time.time()
+            for _ in range(12):
+                c.generate(probe, steps=1)
+            cap_rps = 12 / max(time.time() - t0, 1e-6)
+            base = max(1.0, 0.25 * cap_rps)
+            script = (f"spike:base={base:.2f},mult=10,"
+                      f"warm=3,burst=6,cool=12")
+            spike_arms["static"] = run_open_loadgen(
+                d["host"], d["port"], (1,), script=script, seed=16,
+                batch_frac=0.6, sessions=6, session_zipf=1.2,
+                batch_prefix=batch_prefix, slo_ms=slo_ms,
+                timeout_s=60.0, max_inflight=512)
+        finally:
+            _stop_rnn(p)
+
+        p, d, c = _boot_rnn(["--autoscale-max", "2"], auto_env, "auto")
+        scale_up_seen = scale_down_seen = False
+        sessions_preserved = None
+        try:
+            # a session born on the floor replica BEFORE the spike: it
+            # must survive the scale-up/scale-down arc bit-identically
+            st, r1 = c.generate(probe, session="bench-drain", steps=1)
+            hist = probe + r1["tokens"] if st == 200 else None
+            got = {}
+
+            def drive_spike():
+                got["rec"] = run_open_loadgen(
+                    d["host"], d["port"], (1,), script=script,
+                    seed=16, batch_frac=0.6, sessions=6,
+                    session_zipf=1.2, batch_prefix=batch_prefix,
+                    slo_ms=slo_ms, timeout_s=60.0, max_inflight=512)
+
+            ta = threading.Thread(target=drive_spike, daemon=True)
+            ta.start()
+            dl = time.time() + 300
+            while ta.is_alive() and time.time() < dl:
+                try:
+                    _, m = c.metrics()
+                    if m.get("replicas_active", 0) >= 2:
+                        scale_up_seen = True
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            ta.join(300)
+            spike_arms["autoscale"] = got.get("rec") or {}
+            dl = time.time() + 180
+            while time.time() < dl:
+                try:
+                    _, m = c.metrics()
+                    if scale_up_seen and m.get("replicas_active") == 1:
+                        scale_down_seen = True
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            if hist is not None:
+                st, warm_ans = c.generate(
+                    hist, session="bench-drain", steps=1)
+                st2, cold_ans = c.generate(hist, steps=1)
+                sessions_preserved = bool(
+                    st == 200 and st2 == 200
+                    and warm_ans["tokens"] == cold_ans["tokens"]
+                    and warm_ans["probs"] == cold_ans["probs"])
+        finally:
+            _stop_rnn(p)
+
+        def _spike_cls(lgr, cname):
+            cc = (lgr.get("classes") or {}).get(cname) or {}
+            return {k: cc.get(k) for k in
+                    ("offered", "ok", "shed", "failed", "p99_ms",
+                     "slo_ok_frac")}
+
+        lg_static, lg_auto = spike_arms["static"], spike_arms["autoscale"]
+        autoscale_arm = {
+            "script": script,
+            "seed": 16,
+            "slo_ms": slo_ms,
+            "control_slo_ms": 50.0,
+            "capacity_rps": round(cap_rps, 1),
+            "batch_prefix": batch_prefix,
+            "static": {
+                "interactive": _spike_cls(lg_static, "interactive"),
+                "batch": _spike_cls(lg_static, "batch"),
+                "failed": lg_static.get("failed_requests"),
+                "session_failed": lg_static.get(
+                    "session_failed_requests"),
+            },
+            "autoscale": {
+                "interactive": _spike_cls(lg_auto, "interactive"),
+                "batch": _spike_cls(lg_auto, "batch"),
+                "failed": lg_auto.get("failed_requests"),
+                "session_failed": lg_auto.get(
+                    "session_failed_requests"),
+            },
+            "scale_up_observed": scale_up_seen,
+            "scale_down_observed": scale_down_seen,
+        }
+
         speedup = (
             round(cold_warmup / warm_warmup, 3)
             if warm_warmup else None
@@ -954,6 +1137,22 @@ def bench_serving_tier(platform: str) -> dict:
                 round(100 * (1 - warm_warmup / cold_warmup), 1)
                 if warm_warmup and cold_warmup else None
             ),
+            # the 10x-spike A/B (arm 4): the elastic+admission tier's
+            # interactive p99-within-SLO fraction is gated by an
+            # absolute floor in bench_diff; the static arm's fraction
+            # and the gap are the evidence the spike actually bites
+            "autoscale": autoscale_arm,
+            "autoscale_slo_ok_frac": lg_auto.get("value"),
+            "static_slo_ok_frac": lg_static.get("value"),
+            "autoscale_slo_gap": (
+                round(lg_auto["value"] - lg_static["value"], 4)
+                if lg_auto.get("value") is not None
+                and lg_static.get("value") is not None else None
+            ),
+            "autoscale_failed_requests": lg_auto.get("failed_requests"),
+            "autoscale_session_failed": lg_auto.get(
+                "session_failed_requests"),
+            "autoscale_sessions_preserved": sessions_preserved,
             "host_cpus": os.cpu_count(),
         }
     finally:

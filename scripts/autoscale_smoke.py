@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Autoscale + admission smoke (ISSUE 16 tentpole, run by scripts/check.sh).
+
+The 10x-spike story end-to-end on CPU, chaos included:
+
+1. boot a router on the char-rnn decoder with ``--replicas 1
+   --autoscale-max 2`` (floor 1, ceiling 2) and admission control on,
+   control-loop windows shrunk via env so the whole arc fits a smoke;
+2. probe per-replica capacity closed-loop, then fire the open-loop
+   spike script (``spike: base -> 12x for 8s -> base``), 60% batch /
+   40% interactive with 5 zipf-skewed sessions riding ``/generate``;
+3. assert the controller scales 1 -> 2 while the spike burns, and
+   that the shed ledger shows batch refusals (429) — the admission
+   story — while **zero** requests outright fail;
+4. chaos: SIGKILL the original replica mid-run; a held session must
+   answer on the peer, marked ``migrated`` + counted, and rebuild to
+   the **bit-identical** distribution a cold sessionless request gives;
+5. after traffic stops: windowed p99 back under the SLO, then the
+   idle tier drains back to width 1 — and the session that lived on
+   the drained replica STILL answers identically (zero lost sessions
+   during scale-down).
+
+Never touches GET /healthz — that endpoint feeds the *cumulative*
+request histogram to the scrape-driven SLO detector, which by design
+cannot un-burn after a spike; the smoke reads ``/metrics.json`` (same
+snapshot, no advisory side effects) like the controller reads its own
+windowed series.
+
+Exit 0 on success; any assertion prints the evidence and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+DEPLOY = os.path.join(
+    REPO, "sparknet_tpu", "models", "prototxt", "char_rnn_deploy.prototxt"
+)
+
+# the client-facing SLO the loadgen record scores against
+SLO_MS = 400.0
+# the control loop's internal p99 budget — deliberately much tighter
+# than the client SLO, because the router measures latency from
+# dispatch, AFTER its own ingress queue (socket backlog + handler
+# threads): under overload clients see seconds while the router sees
+# tens of ms, so the loop must trip on the early signal it CAN see
+# (docs/SERVING.md "two SLOs")
+CONTROL_SLO_MS = 50.0
+# each batch request rebuilds a 32-token prefix (O(prefix) decode
+# steps) — expensive enough that the burst saturates service capacity,
+# so the p99 breach that trips the scale-up is load-shaped
+BATCH_PREFIX = 32
+
+# control-loop + admission knobs for the tier subprocess: short burn
+# windows (2s/12s) so the advisory trips inside an 8s burst AND clears
+# within seconds of recovery; a 45s down-cooldown keeps the idle
+# scale-down from racing the chaos respawn assertions.
+TIER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "SPARKNET_SLO_P99_MS": str(int(CONTROL_SLO_MS)),
+    "SPARKNET_SLO_FAST_S": "2",
+    "SPARKNET_SLO_SLOW_S": "12",
+    "SPARKNET_AUTOSCALE_INTERVAL_S": "0.25",
+    "SPARKNET_AUTOSCALE_WINDOW_S": "2",
+    "SPARKNET_AUTOSCALE_UP_LOOKS": "2",
+    "SPARKNET_AUTOSCALE_UP_COOLDOWN_S": "2",
+    "SPARKNET_AUTOSCALE_DOWN_LOOKS": "12",
+    "SPARKNET_AUTOSCALE_DOWN_COOLDOWN_S": "45",
+    "SPARKNET_AUTOSCALE_DOWN_FRAC": "0.9",
+    "SPARKNET_AUTOSCALE_DRAIN_TIMEOUT_S": "15",
+    "SPARKNET_ADMIT_OUTSTANDING": "4",
+    "SPARKNET_ADMIT_HARD_FACTOR": "8",
+}
+
+
+def wait_for(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.3)
+    raise SystemExit(f"autoscale smoke: timed out waiting for {what}")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    tmp = tempfile.mkdtemp(prefix="autoscale_smoke_")
+    portfile = os.path.join(tmp, "router.json")
+    log = open(os.path.join(tmp, "tier.log"), "w")
+
+    env = dict(os.environ)
+    env.update(TIER_ENV)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sparknet_tpu.tools.serve",
+         "--model", DEPLOY,
+         "--replicas", "1", "--autoscale-max", "2",
+         "--port", "0", "--buckets", "1",
+         "--portfile", portfile,
+         "--run-dir", os.path.join(tmp, "run")],
+        cwd=REPO, stdout=log, stderr=subprocess.STDOUT, env=env,
+    )
+    try:
+        wait_for(
+            lambda: os.path.exists(portfile) or proc.poll() is not None,
+            300, "router portfile",
+        )
+        if proc.poll() is not None:
+            print(open(log.name).read()[-3000:])
+            raise SystemExit("autoscale smoke: tier died at boot")
+        doc = json.load(open(portfile))
+
+        from sparknet_tpu.serve.loadgen import run_open_loadgen
+        from sparknet_tpu.serve.server import Client
+
+        client = Client(doc["host"], doc["port"], timeout=60, retries=4)
+
+        def snap():
+            try:
+                _, m = client.metrics()
+                return m
+            except Exception:
+                return None
+
+        def tier(pred, what=None):
+            # one /metrics.json poll shaped for wait_for
+            def go():
+                m = snap()
+                return m if (m and pred(m)) else None
+            return go
+
+        wait_for(tier(lambda m: m["replicas_healthy"] >= 1),
+                 300, "1 healthy replica")
+
+        # ---- a known session BEFORE any chaos: its state lives on the
+        # single floor replica, so the chaos kill provably orphans it
+        prefix = [ord(c) - 32 for c in "survive the spike"]
+        st, r1 = client.generate(prefix, session="chaos", steps=1)
+        assert st == 200, (st, r1)
+        hist = prefix + r1["tokens"]
+        pid0 = wait_for(
+            lambda: (snap() or {}).get("replicas", [{}])[0].get("pid"),
+            60, "replica 0 pid",
+        )
+
+        # ---- closed-loop capacity probe with the BATCH shape (the
+        # request class that saturates the tier): sequential, warm
+        probe = [i % 96 for i in range(BATCH_PREFIX)]
+        for _ in range(3):
+            client.generate(probe, steps=1)
+        n = 12
+        t0 = time.time()
+        for _ in range(n):
+            st, _ = client.generate(probe, steps=1)
+            assert st == 200
+        cap_rps = n / max(time.time() - t0, 1e-6)
+        # peak = 12 x base = 3 x measured capacity: deep enough to
+        # breach, shallow enough that admission keeps failures at zero
+        # (6x starts refusing TCP connects outright on a 1-cpu host)
+        base = max(1.0, 0.25 * cap_rps)
+        script = f"spike:base={base:.2f},mult=12,warm=4,burst=8,cool=40"
+        print(f"autoscale smoke: capacity ~{cap_rps:.1f} rps/replica, "
+              f"script {script}", flush=True)
+
+        # ---- open-loop spike in a thread; main thread watches the tier
+        box = {}
+
+        def drive():
+            box["rec"] = run_open_loadgen(
+                doc["host"], doc["port"], (1,),
+                script=script, seed=16, batch_frac=0.6,
+                sessions=5, session_zipf=1.2, session_steps=1,
+                batch_prefix=BATCH_PREFIX,
+                slo_ms=SLO_MS, timeout_s=60.0, max_inflight=512,
+            )
+
+        gen = threading.Thread(target=drive, name="loadgen", daemon=True)
+        t_start = time.time()
+        gen.start()
+
+        # ---- 1 -> 2 while the spike burns (warm 4s + burst 8s + slack)
+        wait_for(tier(lambda m: m["replicas_active"] >= 2),
+                 60, "scale-up to 2 active replicas")
+        t_up = time.time() - t_start
+        print(f"autoscale smoke: scaled up at t={t_up:.1f}s", flush=True)
+        wait_for(tier(lambda m: m["replicas_healthy"] >= 2),
+                 240, "2 healthy replicas")
+
+        # ---- chaos: SIGKILL the floor replica (holds every session
+        # born before the scale-up, including "chaos")
+        os.kill(pid0, signal.SIGKILL)
+        print(f"autoscale smoke: killed replica 0 (pid {pid0})",
+              flush=True)
+        wait_for(
+            tier(lambda m: any(
+                not r["healthy"] for r in m["replicas"]
+                if not r["retired"]
+            ) and m["replicas_healthy"] >= 1),
+            30, "router to notice the kill",
+        )
+        st, r2 = client.generate(hist, session="chaos", steps=1)
+        assert st == 200, f"session died with its holder: {st} {r2}"
+        assert r2.get("migrated") is True, (
+            f"orphaned session not marked migrated: {r2}"
+        )
+        assert r2["cache_state"] == "cold", r2
+        hist = hist + r2["tokens"]
+        migs = wait_for(
+            lambda: (snap() or {}).get("router", {})
+            .get("session_migrations", 0) or None,
+            30, "migration count",
+        )
+
+        # ---- the pool respawns the kill; loadgen finishes
+        wait_for(tier(lambda m: m["replicas_healthy"] >= 2),
+                 240, "respawn after chaos kill")
+        gen.join(timeout=240)
+        assert not gen.is_alive(), "loadgen never finished"
+        rec = box["rec"]
+
+        # ---- the survival ledger
+        assert rec["failed_requests"] == 0, (
+            f"failed requests during the spike: "
+            f"{rec['failed_requests']} {rec['error_samples']}"
+        )
+        assert rec["session_failed_requests"] == 0, (
+            f"session-correctness errors: {rec['sessions']}"
+        )
+        shed = rec["classes"]["batch"]["shed"]
+        assert shed > 0, (
+            "admission never shed batch — the spike did not bite: "
+            f"{rec['classes']}"
+        )
+        assert rec["classes"]["interactive"]["ok"] > 0
+        m = wait_for(tier(lambda m: True), 30, "metrics")
+        adm = m["router"]["admission"]
+        assert adm.get("batch", {}).get("shed", 0) > 0, adm
+
+        # ---- recovery: windowed p99 back under the control budget
+        # (or the window already drained empty)
+        def recovered(m):
+            w = m["router"]["window"]
+            return w["p99_ms"] is None or w["p99_ms"] < CONTROL_SLO_MS
+
+        wait_for(tier(recovered), 60, "windowed p99 back under SLO")
+
+        # ---- idle scale-down: drain + retire back to the floor
+        wait_for(tier(lambda m: m["replicas_active"] == 1),
+                 240, "scale-down back to 1 replica")
+        t_down = time.time() - t_start
+
+        # ---- zero lost sessions during scale-down: "chaos" lived on
+        # the drained replica; it must still answer, bit-identical to
+        # a cold sessionless rebuild of the same prefix
+        st, r3 = client.generate(hist, session="chaos", steps=1)
+        assert st == 200, f"session lost in scale-down: {st} {r3}"
+        st, cold = client.generate(hist, steps=1)
+        assert st == 200, (st, cold)
+        assert (r3["tokens"] == cold["tokens"]
+                and r3["probs"] == cold["probs"]), (
+            f"drained session diverged from cold path:\n  {r3}\n  {cold}"
+        )
+
+        print(
+            "autoscale smoke: OK — 12x spike survived "
+            f"(up at t={t_up:.0f}s, down at t={t_down:.0f}s, "
+            f"batch shed={shed}, migrations={migs}, "
+            f"interactive slo_ok_frac={rec['value']:.2f}, "
+            "0 failed requests, 0 session errors, "
+            "drained session == cold path)"
+        )
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        log.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

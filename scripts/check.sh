@@ -383,6 +383,22 @@ else
   exit 1
 fi
 
+# ---- autoscale smoke (ISSUE 16): a 1-replica char-rnn tier with
+# --autoscale-max 2 takes a seeded 12x open-loop spike — the controller
+# must scale 1->2 on the windowed-p99 breach, admission must shed batch
+# (429) while interactive keeps answering, a holder SIGKILL mid-burst
+# must resolve to a counted migration (post-kill step migrated+cold),
+# the tier must scale back to 1 after the cool window draining the
+# session-holder through the migration path, and the drained session's
+# next step must equal a fresh cold-path request bitwise — zero failed
+# requests, zero session errors end to end.
+if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/autoscale_smoke.py; then
+  echo "check.sh: autoscale smoke OK (12x spike -> scale 1->2->1, batch shed, holder kill -> migration, 0 failed)"
+else
+  echo "check.sh: autoscale SMOKE FAILED"
+  exit 1
+fi
+
 # ---- quant smoke (ISSUE 12): an int8 1-replica tier hot-swaps a
 # manifest-verified snapshot (scales re-captured at swap time), the
 # quant tag rides /healthz and /classify next to gen, f32-vs-int8

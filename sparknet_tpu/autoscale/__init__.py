@@ -1,0 +1,30 @@
+"""Traffic-shaped autoscaling + SLO admission control (ISSUE 16).
+
+The serving tier's reaction to load.  PRs 9-14 built the sensors and
+the actuators — burn-rate SLO detection (telemetry/anomaly.py), cheap
+warm restarts (the persistent compile cache), countable session
+migration (serve/router.py) — but nothing *acted* on load.  This
+package closes the loop:
+
+- :mod:`~sparknet_tpu.autoscale.traffic` — deterministic open-loop
+  arrival schedules (spike / ramp / sine-diurnal / composed scripts),
+  the precondition for observing overload at all;
+- :mod:`~sparknet_tpu.autoscale.policy` — the pure scale-up/down
+  decision function (hysteresis, cooldowns, learned per-replica
+  capacity), clock-injectable and replayable in tests;
+- :mod:`~sparknet_tpu.autoscale.admission` — per-class (interactive
+  vs batch) front-door shed verdicts driven by the ``slo_burn``
+  advisory and queue pressure;
+- :mod:`~sparknet_tpu.autoscale.controller` — the control loop wiring
+  policy decisions to the router's grow/drain/retire surface
+  (``supervise/pool.py`` children underneath).
+
+Mechanism lives in serve/ and supervise/; everything here is decision
+logic plus the loop that applies it (docs/SERVING.md "Autoscaling &
+admission control").
+"""
+
+from .admission import AdmissionPolicy  # noqa: F401
+from .controller import AutoscaleController  # noqa: F401
+from .policy import AutoscalePolicy  # noqa: F401
+from .traffic import arrivals, parse_script, schedule  # noqa: F401

@@ -1,0 +1,109 @@
+"""SLO admission control — per-class front-door shed verdicts.
+
+The router (serve/router.py) today discovers overload at the *back*:
+requests queue at a replica until the batcher's deadline sheds them
+as 503s, long after the latency budget is gone.  Admission control
+refuses work at the *front* door, and refuses the right work first:
+
+- every request carries a class in the ``X-Sparknet-Class`` header —
+  ``batch`` (throughput traffic, retryable later) or anything else =
+  ``interactive`` (a user is waiting);
+- **batch sheds first**: a live ``slo_burn`` advisory (the PR 11
+  multi-window burn-rate detector, telemetry/anomaly.py) or queue
+  pressure past ``max_outstanding_per_replica`` × healthy sheds
+  batch-class with **429** + ``Retry-After`` — an explicit refusal
+  the client must not blind-retry;
+- **interactive sheds only at meltdown**: outstanding past
+  ``hard_factor`` × the batch threshold gets **503** +
+  ``Retry-After`` — better an honest refusal than a timeout that
+  burned the whole budget anyway.
+
+This class is the pure verdict function (like policy.py for scaling):
+the router feeds it the live signals and owns the HTTP mechanics —
+shed responses still carry ``X-Sparknet-Trace``/span headers so a
+refused request leaves the same forensic trail as a served one, and
+``router_admission{class=,verdict=}`` counters land in
+``/metrics.json``.
+
+Knobs default from ``SPARKNET_ADMIT_*`` env; constructor args win.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from .policy import _env_float
+
+BATCH = "batch"
+INTERACTIVE = "interactive"
+
+
+def normalize_class(cls: Optional[str]) -> str:
+    """Header value -> class name: ``batch`` is batch, everything else
+    (absent, empty, unknown) is interactive — unknown traffic gets the
+    user-facing priority, never the sheddable one."""
+    return BATCH if (cls or "").strip().lower() == BATCH else INTERACTIVE
+
+
+class AdmissionPolicy:
+    """``check()`` per request; returns ``("admit", None, None)`` or
+    ``("shed", http_code, reason)``."""
+
+    def __init__(
+        self,
+        *,
+        max_outstanding_per_replica: Optional[float] = None,
+        hard_factor: Optional[float] = None,
+        retry_after_s: float = 1.0,
+    ):
+        self.max_outstanding_per_replica = (
+            max_outstanding_per_replica
+            if max_outstanding_per_replica is not None
+            else _env_float("SPARKNET_ADMIT_OUTSTANDING", 8.0)
+        )
+        self.hard_factor = (
+            hard_factor if hard_factor is not None
+            else _env_float("SPARKNET_ADMIT_HARD_FACTOR", 4.0)
+        )
+        if self.max_outstanding_per_replica <= 0:
+            raise ValueError(
+                "admission: max_outstanding_per_replica must be > 0, "
+                f"got {self.max_outstanding_per_replica}"
+            )
+        if self.hard_factor < 1.0:
+            raise ValueError(
+                "admission: hard_factor must be >= 1 (interactive can "
+                f"never shed before batch), got {self.hard_factor}"
+            )
+        self.retry_after_s = float(retry_after_s)
+
+    def check(
+        self,
+        cls: Optional[str],
+        *,
+        burn: bool,
+        outstanding: int,
+        healthy: int,
+    ) -> Tuple[str, Optional[int], Optional[str]]:
+        """``burn``: the ``slo_burn`` advisory is live; ``outstanding``:
+        tier-wide in-flight count; ``healthy``: replicas able to take
+        work.  With nothing healthy the verdict is admit — dispatch
+        already owns the all-down 503 and a shed would misattribute
+        an outage as admission."""
+        cls = normalize_class(cls)
+        if healthy <= 0:
+            return ("admit", None, None)
+        cap = self.max_outstanding_per_replica * healthy
+        pressure = outstanding >= cap
+        if cls == BATCH and (burn or pressure):
+            return ("shed", 429, "slo_burn" if burn else "queue_pressure")
+        if cls == INTERACTIVE and outstanding >= self.hard_factor * cap:
+            return ("shed", 503, "overload")
+        return ("admit", None, None)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "max_outstanding_per_replica": self.max_outstanding_per_replica,
+            "hard_factor": self.hard_factor,
+            "retry_after_s": self.retry_after_s,
+        }

@@ -1,0 +1,195 @@
+"""The autoscale control loop — policy decisions applied to a router.
+
+One daemon thread (or test-driven ``look()`` calls) per router.  Each
+look:
+
+1. reads the router's windowed arrival-rate and p99 series
+   (``RouterMetrics.windowed()`` — the registry's ``router`` source),
+   publishing them as ``router_arrival_rate_rps`` /
+   ``router_window_p99_ms`` gauges;
+2. feeds the windowed p99 to its own
+   :class:`~sparknet_tpu.telemetry.anomaly.SloBurnRateDetector`, so
+   the ``slo_burn`` advisory tracks *recent* latency and clears after
+   recovery (the scrape-driven detector judges a cumulative histogram,
+   which can never un-burn — fine for alerting, wrong for control);
+3. progresses any in-flight drain: a draining replica whose
+   outstanding count reached zero is retired (its pool child is
+   stopped deliberately — ``STOPPED``, not a failure), past
+   ``drain_timeout_s`` it is retired anyway (counted ``forced``);
+4. asks the policy, then acts: **up** re-arms a retired pool slot or
+   appends a fresh child (warm restarts make this cheap — the
+   persistent compile cache, PR 9); **down** begins draining the
+   highest-index active replica — no new dispatches land on it,
+   session affinity falls back to peers, and every held session
+   migrates through PR 13's *counted* path (the holder table keeps
+   the old index until a peer answers, so the change is measured as
+   ``router_events{event="session_migrate"}``, never silent).
+
+Every action prints one ``autoscale:`` JSON line and bumps
+``autoscale_events{action=}``; the controller registers as the
+registry's ``autoscale`` source so ``/metrics.json`` carries the loop
+state next to the router's.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..telemetry import anomaly
+from ..telemetry.registry import REGISTRY
+from .policy import AutoscalePolicy, _env_float
+
+
+class AutoscaleController:
+    """Wires an :class:`AutoscalePolicy` to a
+    :class:`~sparknet_tpu.serve.router.Router`'s scale surface
+    (``scale_up`` / ``begin_drain`` / ``replica_drained`` /
+    ``retire_replica``)."""
+
+    def __init__(
+        self,
+        router,
+        policy: Optional[AutoscalePolicy] = None,
+        *,
+        interval_s: Optional[float] = None,
+        window_s: Optional[float] = None,
+        drain_timeout_s: Optional[float] = None,
+        burn_detector=None,
+        emit=print,
+        now=time.monotonic,
+    ):
+        self.router = router
+        self.policy = policy or AutoscalePolicy()
+        self.interval_s = (
+            interval_s if interval_s is not None
+            else _env_float("SPARKNET_AUTOSCALE_INTERVAL_S", 0.5)
+        )
+        self.window_s = (
+            window_s if window_s is not None
+            else _env_float("SPARKNET_AUTOSCALE_WINDOW_S", 5.0)
+        )
+        self.drain_timeout_s = (
+            drain_timeout_s if drain_timeout_s is not None
+            else _env_float("SPARKNET_AUTOSCALE_DRAIN_TIMEOUT_S", 20.0)
+        )
+        # windowed burn detection over the SAME slo as the policy —
+        # the advisory this raises is what admission sheds on.  Short
+        # refire/ttl (scaled to the look cadence, gap-free since
+        # refire < ttl): the advisory must CLEAR soon after recovery
+        # or the scale-down calm streak could never build.
+        refire = max(self.interval_s, 1.0)
+        self._burn = burn_detector or anomaly.SloBurnRateDetector(
+            slo_ms=self.policy.slo_ms, refire_s=refire,
+            ttl_s=3.0 * refire, emit=emit,
+        )
+        self.emit = emit
+        self._now = now
+        self._draining: Dict[int, float] = {}  # index -> force deadline
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.drains_forced = 0
+        self.looks = 0
+        self._last = {}  # newest windowed observation (snapshot fodder)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        REGISTRY.register_source("autoscale", self)
+
+    # ------------------------------------------------------------------
+    def _event(self, action: str, **info) -> None:
+        REGISTRY.counter("autoscale_events", action=action).inc()
+        try:
+            self.emit("autoscale: " + json.dumps({"action": action, **info}))
+        except Exception:
+            pass  # a closed sink must not kill the control loop
+
+    def look(self) -> Dict[str, Any]:
+        """One control iteration — public so tests replay it without
+        the thread."""
+        self.looks += 1
+        w = self.router.metrics.windowed(self.window_s)
+        self._last = w
+        rate, p99 = w["rate_rps"], w["p99_ms"]
+        REGISTRY.gauge("router_arrival_rate_rps").set(rate)
+        if p99 is not None:
+            REGISTRY.gauge("router_window_p99_ms").set(p99)
+            self._burn.observe(p99)
+        burn = bool(anomaly.active("slo_burn"))
+        # ---- progress drains before deciding anything new
+        now = self._now()
+        for idx in sorted(self._draining):
+            drained = self.router.replica_drained(idx)
+            forced = not drained and now >= self._draining[idx]
+            if not (drained or forced):
+                continue
+            del self._draining[idx]
+            self.router.retire_replica(idx)
+            self.scale_downs += 1
+            if forced:
+                self.drains_forced += 1
+            self._event(
+                "scale_down", replica=idx,
+                forced=forced, width=self.router.active_width(),
+            )
+        width = self.router.active_width() - len(self._draining)
+        healthy = self.router.healthy_count()
+        decision = self.policy.decide(
+            rate_rps=rate, p99_ms=p99, healthy=healthy,
+            width=width, burn=burn,
+        )
+        if decision["action"] == "up":
+            idx = self.router.scale_up()
+            if idx is not None:
+                self.scale_ups += 1
+                self._event(
+                    "scale_up", replica=idx, reason=decision["reason"],
+                    rate_rps=rate, p99_ms=p99,
+                    width=self.router.active_width(),
+                )
+        elif decision["action"] == "down" and not self._draining:
+            idx = self.router.pick_drain_victim()
+            if idx is not None and self.router.begin_drain(idx):
+                self._draining[idx] = now + self.drain_timeout_s
+                self._event(
+                    "drain_begin", replica=idx,
+                    reason=decision["reason"], rate_rps=rate,
+                )
+        REGISTRY.gauge("autoscale_width").set(
+            self.router.active_width()
+        )
+        return decision
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.look()
+            except Exception:
+                continue  # a look crash must not kill the loop
+
+    # ------------------------------------------------------------------
+    def start(self) -> "AutoscaleController":
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscale", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval_s + 5.0)
+            self._thread = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "looks": self.looks,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "drains_forced": self.drains_forced,
+            "draining": sorted(self._draining),
+            "width": self.router.active_width(),
+            "window": dict(self._last),
+            "policy": self.policy.snapshot(),
+        }

@@ -1,0 +1,229 @@
+"""The autoscale decision function — pure, clock-injectable, replayable.
+
+Separated from the control loop (controller.py) the same way
+``supervise/policy.py`` is separated from the supervisor: the loop
+owns threads and IO, the policy owns arithmetic, so a test can replay
+a synthetic (rate, p99, burn) series and pin every decision.
+
+Inputs per look (all measured by the router over a sliding window):
+arrival rate (rps), windowed p99 (ms), the count of healthy replicas,
+the current active width, and whether the ``slo_burn`` advisory
+(telemetry/anomaly.py) is live.  Output: ``hold`` / ``up`` / ``down``
+with a reason string.
+
+Scale-**up** when the tier is breaching — the burn advisory is live,
+or the windowed p99 exceeds the SLO — for ``up_looks`` consecutive
+looks (one bad window is noise, a streak is load), bounded by
+``max_replicas`` and an ``up_cooldown_s`` so a spawning replica gets
+to land before the next verdict.
+
+Scale-**down** is deliberately harder (hysteresis): the policy learns
+per-replica capacity as the highest observed ``rate/healthy`` while
+the SLO held, and only shrinks when the offered rate would fit in
+``down_frac`` of the *smaller* tier's learned capacity for
+``down_looks`` consecutive calm looks, past a ``down_cooldown_s``.
+A fully idle window (no arrivals, no latency samples) counts as calm
+— an idle tier shrinks back to the floor.  No learned capacity yet ⇒
+never down — shrinking on a guess is how autoscalers flap.
+
+Knobs default from ``SPARKNET_AUTOSCALE_*`` env (same pattern as the
+anomaly detectors), constructor args win.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from None
+
+
+class AutoscalePolicy:
+    """``decide()`` once per controller look; returns
+    ``{"action": "hold"|"up"|"down", "reason": ..., ...}``.  One step
+    per decision — the cooldowns are what rate-limit a 10x spike into
+    a sane climb, not a multi-step jump."""
+
+    def __init__(
+        self,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        slo_ms: Optional[float] = None,
+        up_looks: Optional[int] = None,
+        down_looks: Optional[int] = None,
+        up_cooldown_s: Optional[float] = None,
+        down_cooldown_s: Optional[float] = None,
+        down_frac: Optional[float] = None,
+        now=time.monotonic,
+    ):
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.slo_ms = (
+            float(slo_ms) if slo_ms is not None
+            else _env_float("SPARKNET_SLO_P99_MS", 250.0)
+        )
+        self.up_looks = int(
+            up_looks if up_looks is not None
+            else _env_float("SPARKNET_AUTOSCALE_UP_LOOKS", 2)
+        )
+        self.down_looks = int(
+            down_looks if down_looks is not None
+            else _env_float("SPARKNET_AUTOSCALE_DOWN_LOOKS", 5)
+        )
+        self.up_cooldown_s = (
+            up_cooldown_s if up_cooldown_s is not None
+            else _env_float("SPARKNET_AUTOSCALE_UP_COOLDOWN_S", 3.0)
+        )
+        self.down_cooldown_s = (
+            down_cooldown_s if down_cooldown_s is not None
+            else _env_float("SPARKNET_AUTOSCALE_DOWN_COOLDOWN_S", 10.0)
+        )
+        self.down_frac = (
+            down_frac if down_frac is not None
+            else _env_float("SPARKNET_AUTOSCALE_DOWN_FRAC", 0.6)
+        )
+        if not 0.0 < self.down_frac <= 1.0:
+            raise ValueError(
+                f"autoscale: down_frac must be in (0, 1], got "
+                f"{self.down_frac}"
+            )
+        self._now = now
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+        # learned per-replica capacity: max rate/healthy sustained
+        # while the windowed p99 held the SLO
+        self.per_replica_rps: Optional[float] = None
+        self.decisions = 0
+
+    # ------------------------------------------------------------------
+    def decide(
+        self,
+        *,
+        rate_rps: float,
+        p99_ms: Optional[float],
+        healthy: int,
+        width: int,
+        burn: bool = False,
+    ) -> Dict[str, Any]:
+        """One look.  ``width`` is the ACTIVE replica count (spawning
+        included, draining excluded) — the thing a decision changes;
+        ``healthy`` is how many currently answer probes."""
+        t = self._now()
+        self.decisions += 1
+        breach = bool(burn) or (
+            p99_ms is not None and p99_ms > self.slo_ms
+        )
+        # calm = comfortably within SLO, or fully idle (an idle tier
+        # must still be able to shrink to the floor — its learned
+        # capacity was established while it had traffic)
+        idle = rate_rps <= 0.0 and p99_ms is None
+        calm = not breach and (
+            idle or (p99_ms is not None and p99_ms <= 0.5 * self.slo_ms)
+        )
+        if breach:
+            self._up_streak += 1
+            self._down_streak = 0
+        else:
+            self._up_streak = 0
+            # capacity learning happens only on non-breach looks with
+            # real traffic: this rate was served within the SLO
+            if healthy > 0 and rate_rps > 0.0 and p99_ms is not None:
+                per = rate_rps / healthy
+                if (self.per_replica_rps is None
+                        or per > self.per_replica_rps):
+                    self.per_replica_rps = per
+            self._down_streak = self._down_streak + 1 if calm else 0
+
+        out: Dict[str, Any] = {
+            "action": "hold",
+            "reason": "steady",
+            "width": width,
+            "breach": breach,
+            "up_streak": self._up_streak,
+            "down_streak": self._down_streak,
+            "per_replica_rps": (
+                round(self.per_replica_rps, 3)
+                if self.per_replica_rps is not None else None
+            ),
+        }
+        # ---- up path
+        if breach and width < self.max_replicas:
+            if self._up_streak < self.up_looks:
+                out["reason"] = "breach streak building"
+                return out
+            if (self._last_up_t is not None
+                    and t - self._last_up_t < self.up_cooldown_s):
+                out["reason"] = "up cooldown"
+                return out
+            self._last_up_t = t
+            self._up_streak = 0
+            out["action"] = "up"
+            out["reason"] = "slo_burn advisory" if burn else (
+                f"windowed p99 {p99_ms:.0f}ms > SLO {self.slo_ms:.0f}ms"
+            )
+            return out
+        if breach:
+            out["reason"] = "breach but at max_replicas"
+            return out
+        # ---- down path (hysteresis: needs learned capacity, a calm
+        # streak, and headroom in the smaller tier)
+        if width > self.min_replicas and self.per_replica_rps is not None:
+            fits = rate_rps <= (
+                self.down_frac * self.per_replica_rps * (width - 1)
+            )
+            if not fits:
+                self._down_streak = 0
+                out["down_streak"] = 0
+                out["reason"] = "rate would not fit the smaller tier"
+                return out
+            if self._down_streak < self.down_looks:
+                out["reason"] = "calm streak building"
+                return out
+            if (self._last_down_t is not None
+                    and t - self._last_down_t < self.down_cooldown_s):
+                out["reason"] = "down cooldown"
+                return out
+            if (self._last_up_t is not None
+                    and t - self._last_up_t < self.down_cooldown_s):
+                # never shrink on the heels of a grow — the classic
+                # flap
+                out["reason"] = "recent scale-up"
+                return out
+            self._last_down_t = t
+            self._down_streak = 0
+            out["action"] = "down"
+            out["reason"] = (
+                f"rate {rate_rps:.1f} rps fits {width - 1} "
+                f"replica(s) at {self.down_frac:g}x learned capacity"
+            )
+            return out
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "slo_ms": self.slo_ms,
+            "up_looks": self.up_looks,
+            "down_looks": self.down_looks,
+            "up_cooldown_s": self.up_cooldown_s,
+            "down_cooldown_s": self.down_cooldown_s,
+            "down_frac": self.down_frac,
+            "per_replica_rps": (
+                round(self.per_replica_rps, 3)
+                if self.per_replica_rps is not None else None
+            ),
+            "decisions": self.decisions,
+        }

@@ -1,0 +1,242 @@
+"""Traffic shapes — deterministic open-loop arrival schedules.
+
+The closed-loop load generator (serve/loadgen.py) can never overload a
+tier: each worker waits for its answer before sending the next
+request, so offered load collapses to served load exactly when the
+tier saturates.  Open-loop traffic fires requests on a *clock* —
+arrival times are drawn up front from a rate script, and a slow tier
+just accumulates backlog, which is what a real spike does to a real
+service.
+
+A **script** is a ``;``-separated sequence of shape segments, each
+``shape:key=val,key=val`` (all values are numbers, seconds and
+requests/second):
+
+- ``flat:rate=10,dur=10`` — constant rate
+- ``spike:base=5,mult=10,warm=5,burst=5,cool=10`` — ``base`` rps with
+  a ``mult``× step between ``warm`` and ``warm+burst`` (the 10x-spike
+  shape; total duration ``warm+burst+cool``)
+- ``ramp:lo=2,hi=20,dur=15`` — linear rate ramp
+- ``sine:mean=10,amp=8,period=30,dur=60`` — the diurnal shape,
+  ``max(0, mean + amp·sin(2πt/period))``
+
+Arrivals are an inhomogeneous Poisson process, realized by thinning
+against each segment's peak rate.  Everything is drawn from
+``numpy.random.default_rng(seed)`` with **no wall-clock input**, so
+two calls with the same (script, seed) produce byte-identical
+timestamps — the determinism bar tests/test_autoscale.py pins.
+``schedule()`` additionally assigns each arrival a request class
+(interactive vs batch) and, when ``sessions > 0``, a Zipf-skewed
+session id (the loadgen's ``zipf_weights`` hot-session shape) from
+the same seed.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class Segment:
+    """One parsed shape segment: duration, rate(t) over local time in
+    [0, dur), and the analytic peak rate (the thinning envelope)."""
+
+    __slots__ = ("shape", "dur", "rate", "peak")
+
+    def __init__(self, shape: str, dur: float,
+                 rate: Callable[[float], float], peak: float):
+        if dur <= 0:
+            raise ValueError(f"traffic: {shape}: dur must be > 0, got {dur}")
+        if peak < 0:
+            raise ValueError(f"traffic: {shape}: negative rate ({peak})")
+        self.shape = shape
+        self.dur = float(dur)
+        self.rate = rate
+        self.peak = float(peak)
+
+
+def _params(body: str, defaults: dict, shape: str) -> dict:
+    out = dict(defaults)
+    for kv in (body or "").split(","):
+        kv = kv.strip()
+        if not kv:
+            continue
+        if "=" not in kv:
+            raise ValueError(
+                f"traffic: {shape}: expected key=value, got {kv!r}"
+            )
+        k, v = kv.split("=", 1)
+        k = k.strip()
+        if k not in defaults:
+            raise ValueError(
+                f"traffic: unknown key {k!r} for shape {shape!r} "
+                f"(knobs: {sorted(defaults)})"
+            )
+        try:
+            out[k] = float(v)
+        except ValueError:
+            raise ValueError(
+                f"traffic: {shape}: {k} must be a number, got {v!r}"
+            ) from None
+    return out
+
+
+def parse_script(script: str) -> List[Segment]:
+    """Parse a traffic script into segments (run back to back)."""
+    segs: List[Segment] = []
+    for part in str(script).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        shape, _, body = part.partition(":")
+        shape = shape.strip().lower()
+        if shape == "flat":
+            p = _params(body, {"rate": 10.0, "dur": 10.0}, shape)
+            segs.append(Segment(
+                shape, p["dur"], lambda t, r=p["rate"]: r, p["rate"]
+            ))
+        elif shape == "spike":
+            p = _params(body, {
+                "base": 5.0, "mult": 10.0, "warm": 5.0,
+                "burst": 5.0, "cool": 10.0,
+            }, shape)
+            base, peak = p["base"], p["base"] * max(p["mult"], 1.0)
+            w, b = p["warm"], p["burst"]
+
+            def rate(t, base=base, hi=p["base"] * p["mult"], w=w, b=b):
+                return hi if w <= t < w + b else base
+
+            segs.append(Segment(shape, w + b + p["cool"], rate, peak))
+        elif shape == "ramp":
+            p = _params(body, {"lo": 2.0, "hi": 20.0, "dur": 10.0}, shape)
+
+            def rate(t, lo=p["lo"], hi=p["hi"], d=p["dur"]):
+                return lo + (hi - lo) * (t / d)
+
+            segs.append(Segment(
+                shape, p["dur"], rate, max(p["lo"], p["hi"])
+            ))
+        elif shape == "sine":
+            p = _params(body, {
+                "mean": 10.0, "amp": 8.0, "period": 30.0, "dur": 60.0,
+            }, shape)
+            if p["period"] <= 0:
+                raise ValueError("traffic: sine: period must be > 0")
+
+            def rate(t, m=p["mean"], a=p["amp"], per=p["period"]):
+                return max(0.0, m + a * math.sin(2.0 * math.pi * t / per))
+
+            segs.append(Segment(
+                shape, p["dur"], rate, max(0.0, p["mean"] + abs(p["amp"]))
+            ))
+        else:
+            raise ValueError(
+                f"traffic: unknown shape {shape!r} "
+                "(shapes: flat, spike, ramp, sine)"
+            )
+    if not segs:
+        raise ValueError(f"traffic: empty script {script!r}")
+    return segs
+
+
+def rate_at(script: str, t: float) -> float:
+    """The script's offered rate at absolute time ``t`` (0 past the
+    end) — the docs/tests view of a parsed script."""
+    base = 0.0
+    for seg in parse_script(script):
+        if t < base + seg.dur:
+            return float(seg.rate(t - base))
+        base += seg.dur
+    return 0.0
+
+
+def arrivals(script: str, seed: int = 0) -> Tuple[List[float], float]:
+    """Draw the arrival offsets (seconds from start, sorted) for one
+    realization of ``script``: ``(times, total_duration)``.  Thinned
+    inhomogeneous Poisson; deterministic given (script, seed)."""
+    segs = parse_script(script)
+    rng = np.random.default_rng(int(seed))
+    out: List[float] = []
+    base_t = 0.0
+    for seg in segs:
+        if seg.peak > 0.0:
+            t = 0.0
+            while True:
+                t += float(rng.exponential(1.0 / seg.peak))
+                if t >= seg.dur:
+                    break
+                # thinning: accept with probability rate(t)/peak
+                if float(rng.random()) * seg.peak <= seg.rate(t):
+                    out.append(base_t + t)
+        base_t += seg.dur
+    return out, base_t
+
+
+class Schedule:
+    """One fully-materialized open-loop plan: per-request arrival
+    offset, class, and (optionally) session id — everything the
+    loadgen needs, all drawn from the seed before the first request
+    fires."""
+
+    __slots__ = (
+        "script", "seed", "times", "classes", "session_ids", "duration",
+    )
+
+    def __init__(self, script, seed, times, classes, session_ids, duration):
+        self.script = script
+        self.seed = seed
+        self.times = times
+        self.classes = classes
+        self.session_ids = session_ids
+        self.duration = duration
+
+    def __len__(self) -> int:
+        return len(self.times)
+
+    def offered_rate(self) -> float:
+        return len(self.times) / max(self.duration, 1e-9)
+
+
+def schedule(
+    script: str,
+    *,
+    seed: int = 0,
+    batch_frac: float = 0.0,
+    sessions: int = 0,
+    session_zipf: float = 1.1,
+) -> Schedule:
+    """Materialize a script into a :class:`Schedule`.  ``batch_frac``
+    of arrivals are tagged class ``batch`` (the sheddable tier), the
+    rest ``interactive``; with ``sessions > 0`` every arrival also
+    draws a Zipf(``session_zipf``)-skewed session id.  All randomness
+    flows from ``seed`` — identical (script, seed, knobs) ⇒ identical
+    plan."""
+    if not 0.0 <= batch_frac <= 1.0:
+        raise ValueError(
+            f"traffic: batch_frac must be in [0, 1], got {batch_frac}"
+        )
+    times, duration = arrivals(script, seed)
+    n = len(times)
+    # independent draws off a second stream so adding classes/sessions
+    # never perturbs the arrival timestamps themselves
+    rng = np.random.default_rng(int(seed) + 1)
+    if batch_frac > 0.0 and n:
+        draws = rng.random(n)
+        classes = [
+            "batch" if d < batch_frac else "interactive" for d in draws
+        ]
+    else:
+        classes = ["interactive"] * n
+    session_ids: Optional[List[int]] = None
+    if sessions > 0:
+        from ..serve.loadgen import zipf_weights
+
+        probs = zipf_weights(int(sessions), float(session_zipf))
+        session_ids = (
+            [int(k) for k in rng.choice(int(sessions), size=n, p=probs)]
+            if n else []
+        )
+    return Schedule(str(script), int(seed), times, classes, session_ids,
+                    duration)

@@ -379,6 +379,321 @@ def run_http_loadgen(
     }
 
 
+def run_open_loadgen(
+    host: str,
+    port: int,
+    input_shape: Sequence[int],
+    *,
+    script: str,
+    seed: int = 0,
+    sizes: Sequence[int] = (1,),
+    timeout_s: float = 30.0,
+    retries: int = 2,
+    batch_frac: float = 0.0,
+    sessions: int = 0,
+    session_zipf: float = 1.1,
+    session_steps: int = 1,
+    session_vocab: int = 96,
+    batch_prefix: int = 1,
+    slo_ms: Optional[float] = None,
+    max_inflight: int = 256,
+) -> dict:
+    """**Open-loop** load generator — requests fire on a clock, not on
+    completions.  ``script`` is a traffic script
+    (:func:`sparknet_tpu.autoscale.traffic.parse_script` grammar); the
+    whole plan — arrival offsets, per-request class, session ids — is
+    materialized from ``seed`` before the first request, so two runs
+    of the same (script, seed) offer byte-identical traffic
+    (tests/test_autoscale.py pins this).  Unlike the closed loop
+    above, a saturated tier here accumulates *backlog*: offered load
+    never bends to served load, which is exactly what a 10x spike does
+    to a real service and what the autoscale bench arm measures.
+
+    ``batch_frac`` of arrivals carry ``X-Sparknet-Class: batch`` (the
+    sheddable class); with ``sessions > 0`` interactive arrivals
+    become ``/generate`` session steps over a Zipf-hot session
+    population (serialized per session — a session IS sequential —
+    with history appended only on success, so a shed or failed step
+    never corrupts the prefix).
+
+    Outcome taxonomy, per class: **ok** (200, right shape), **shed**
+    (an explicit admission refusal — 429, or a final 503 after client
+    retries), **failed** (transport error, timeout, wrong shape — the
+    zero-is-the-bar gate).  Latency is measured from the *scheduled*
+    arrival, so dispatch lateness and any backlog wait count against
+    the SLO, and ``slo_ok_frac`` = within-SLO oks / offered — sheds
+    and failures are SLO misses by definition.  The record's headline
+    ``value`` is the **interactive** ``slo_ok_frac`` (the thing the
+    tier exists to protect); ``client_overflow`` counts arrivals
+    dropped because ``max_inflight`` in-flight threads were already
+    outstanding (a loadgen-capacity artifact, reported so it can gate
+    a run as unsound)."""
+    from ..autoscale.traffic import schedule as _schedule
+    from ..telemetry import reqtrace
+    from .server import Client
+
+    if slo_ms is None:
+        raw = os.environ.get("SPARKNET_SLO_P99_MS", "").strip()
+        slo_ms = float(raw) if raw else 250.0
+    plan = _schedule(
+        script, seed=seed, batch_frac=batch_frac,
+        sessions=sessions, session_zipf=session_zipf,
+    )
+    rng_rows = np.random.default_rng(int(seed) + 2)
+    lock = threading.Lock()
+    sem = threading.Semaphore(max(1, int(max_inflight)))
+    by_class: dict = {}   # class -> {"offered","ok","shed","failed","slo_ok"}
+    lat_by_class: dict = {}           # class -> [latency seconds]
+    shed_reasons: dict = {}           # reason/status -> count
+    errors: list = []
+    failed_traces: list = []
+    lateness: list = []
+    generations = set()
+    session_hist: dict = {}
+    session_locks: dict = {}
+    session_states: dict = {}
+    session_migrated = [0]
+    session_failed = [0]
+    overflow = [0]
+
+    def _bucket(cls: str) -> dict:
+        return by_class.setdefault(cls, {
+            "offered": 0, "ok": 0, "shed": 0, "failed": 0, "slo_ok": 0,
+        })
+
+    def _finish(cls, i, tid, sched_t, status, err):
+        """Classify one outcome under the lock.  ``err`` is an error
+        string (failed), ``status`` the final HTTP status."""
+        dt = time.monotonic() - sched_t
+        with lock:
+            b = _bucket(cls)
+            if err is not None:
+                b["failed"] += 1
+                errors.append(f"req {i}: {err}")
+                if tid is not None:
+                    failed_traces.append({"req": i, "trace": tid})
+            elif status in (429, 503):
+                b["shed"] += 1
+                shed_reasons[str(status)] = (
+                    shed_reasons.get(str(status), 0) + 1
+                )
+            else:
+                b["ok"] += 1
+                lat_by_class.setdefault(cls, []).append(dt)
+                if dt * 1000.0 <= slo_ms:
+                    b["slo_ok"] += 1
+
+    def _one(i: int, cls: str, sid: Optional[int], sched_t: float,
+             rows) -> None:
+        try:
+            client = Client(host, port, timeout=timeout_s, retries=retries)
+            ctx = reqtrace.mint()
+            tid = ctx.trace_id if ctx is not None else None
+            trace = reqtrace.to_header(ctx) if ctx is not None else None
+            if sid is not None and cls != "batch":
+                _session_step(i, sid, client, trace, tid, sched_t)
+                return
+            if sessions > 0 and cls == "batch":
+                # session-mode tiers (char-rnn) have no /classify
+                # shape: batch-class traffic is sessionless /generate
+                # — a full cold rebuild per request, the honest
+                # throughput-tier cost
+                _batch_generate(i, client, trace, tid, sched_t)
+                return
+            try:
+                status, resp = client.classify(
+                    rows, trace=trace,
+                    cls=cls if cls == "batch" else None,
+                )
+            except Exception as e:
+                _finish(cls, i, tid, sched_t,
+                        None, f"{type(e).__name__}: {e}")
+                return
+            if status == 200 and len(resp.get("indices", ())) != len(rows):
+                _finish(cls, i, tid, sched_t, status,
+                        f"{len(resp.get('indices', ()))} rows back, "
+                        f"sent {len(rows)}")
+                return
+            if status not in (200, 429, 503):
+                _finish(cls, i, tid, sched_t, status,
+                        f"HTTP {status}: {resp.get('error')}")
+                return
+            _finish(cls, i, tid, sched_t, status, None)
+            if status == 200:
+                with lock:
+                    if "gen" in resp:
+                        generations.add(int(resp["gen"]))
+        finally:
+            sem.release()
+
+    def _batch_generate(i, client, trace, tid, sched_t) -> None:
+        # batch_prefix sets the sessionless rebuild cost — O(prefix)
+        # decode steps per request — so a spike script can saturate
+        # service capacity on any host speed
+        toks = [(i + j) % session_vocab
+                for j in range(max(1, batch_prefix))]
+        try:
+            status, resp = client.generate(
+                toks, steps=session_steps,
+                trace=trace, cls="batch",
+            )
+        except Exception as e:
+            _finish("batch", i, tid, sched_t,
+                    None, f"{type(e).__name__}: {e}")
+            return
+        if status not in (200, 429, 503):
+            _finish("batch", i, tid, sched_t, status,
+                    f"HTTP {status}: {resp.get('error')}")
+            return
+        if status == 200 and len(resp.get("tokens", ())) != session_steps:
+            _finish("batch", i, tid, sched_t, status,
+                    f"{len(resp.get('tokens', ()))} tokens back, "
+                    f"asked {session_steps}")
+            return
+        _finish("batch", i, tid, sched_t, status, None)
+
+    def _session_step(i, k, client, trace, tid, sched_t) -> None:
+        sid = f"s{k}"
+        with lock:
+            slock = session_locks.setdefault(sid, threading.Lock())
+        with slock:
+            with lock:
+                hist = list(
+                    session_hist.setdefault(sid, [k % session_vocab])
+                )
+            try:
+                status, resp = client.generate(
+                    hist, session=sid, steps=session_steps, trace=trace,
+                )
+            except Exception as e:
+                with lock:
+                    session_failed[0] += 1
+                _finish("interactive", i, tid, sched_t,
+                        None, f"{type(e).__name__}: {e}")
+                return
+            if status in (429, 503):
+                # refused, not corrupted: the prefix stays untouched
+                _finish("interactive", i, tid, sched_t, status, None)
+                return
+            if status != 200:
+                with lock:
+                    session_failed[0] += 1
+                _finish("interactive", i, tid, sched_t, status,
+                        f"HTTP {status}: {resp.get('error')}")
+                return
+            if len(resp.get("tokens", ())) != session_steps:
+                # the session-correctness bar: wrong continuation length
+                with lock:
+                    session_failed[0] += 1
+                _finish("interactive", i, tid, sched_t, status,
+                        f"{len(resp.get('tokens', ()))} tokens back, "
+                        f"asked {session_steps}")
+                return
+            _finish("interactive", i, tid, sched_t, status, None)
+            with lock:
+                session_hist[sid] = hist + [
+                    int(t) for t in resp["tokens"]
+                ]
+                st = str(resp.get("cache_state", "?"))
+                session_states[st] = session_states.get(st, 0) + 1
+                if resp.get("migrated"):
+                    session_migrated[0] += 1
+                if "gen" in resp:
+                    generations.add(int(resp["gen"]))
+
+    threads: list = []
+    t_start = time.monotonic()
+    for i, offset in enumerate(plan.times):
+        cls = plan.classes[i]
+        sid = plan.session_ids[i] if plan.session_ids is not None else None
+        # rows are drawn on the scheduler thread so the draw ORDER (and
+        # with it determinism) is independent of reply timing
+        n = int(sizes[i % len(sizes)])
+        rows = rng_rows.normal(size=(n,) + tuple(input_shape)).astype(
+            np.float32
+        )
+        while True:
+            late = time.monotonic() - (t_start + offset)
+            if late >= 0.0:
+                break
+            time.sleep(min(-late, 0.05))
+        with lock:
+            _bucket(cls)["offered"] += 1
+            lateness.append(max(0.0, late))
+        if not sem.acquire(blocking=False):
+            with lock:
+                overflow[0] += 1
+                _bucket(cls)["failed"] += 1
+                errors.append(f"req {i}: client overflow "
+                              f"(max_inflight={max_inflight})")
+            continue
+        th = threading.Thread(
+            target=_one, args=(i, cls, sid, t_start + offset, rows),
+            daemon=True,
+        )
+        th.start()
+        threads.append(th)
+    deadline = time.monotonic() + timeout_s * 2
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+    wall_s = time.monotonic() - t_start
+
+    def _pct(vals, q):
+        vals = sorted(vals)
+        return (
+            round(vals[int(q * (len(vals) - 1))] * 1000, 3)
+            if vals else None
+        )
+
+    classes_out = {}
+    for cls, b in sorted(by_class.items()):
+        lats = lat_by_class.get(cls, [])
+        classes_out[cls] = {
+            **b,
+            "slo_ok_frac": round(b["slo_ok"] / b["offered"], 4)
+            if b["offered"] else None,
+            "p50_ms": _pct(lats, 0.50),
+            "p99_ms": _pct(lats, 0.99),
+        }
+    inter = classes_out.get("interactive", {})
+    total_failed = sum(b["failed"] for b in by_class.values())
+    return {
+        "metric": "serve_open_loop_slo_ok_frac",
+        "value": inter.get("slo_ok_frac"),
+        "unit": "fraction",
+        "script": plan.script,
+        "seed": plan.seed,
+        "slo_ms": slo_ms,
+        "duration_s": round(plan.duration, 3),
+        "wall_s": round(wall_s, 3),
+        "offered": len(plan),
+        "offered_rate_rps": round(plan.offered_rate(), 3),
+        "classes": classes_out,
+        "shed": dict(sorted(shed_reasons.items())),
+        "failed_requests": total_failed,
+        "error_samples": errors[:5],
+        "failed_request_traces": failed_traces[:20],
+        "client_overflow": overflow[0],
+        "lateness_p99_ms": _pct(lateness, 0.99),
+        "served_generations": sorted(generations),
+        "host_cpus": os.cpu_count(),
+        **(
+            {
+                "sessions": {
+                    "count": sessions,
+                    "zipf": session_zipf,
+                    "steps_per_request": session_steps,
+                    "distinct": len(session_hist),
+                    "states": dict(sorted(session_states.items())),
+                    "migrated": session_migrated[0],
+                },
+                "session_failed_requests": session_failed[0],
+            }
+            if sessions > 0 else {}
+        ),
+    }
+
+
 def _platform() -> str:
     try:
         import jax

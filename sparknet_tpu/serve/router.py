@@ -68,6 +68,12 @@ class Replica:
         self.host = host
         self.port = port
         self.healthy = False
+        # autoscale lifecycle: draining takes no NEW work (session
+        # affinity falls back to peers — the counted-migration path)
+        # while in-flight requests finish; retired is out of the tier
+        # until a scale-up re-arms the slot
+        self.draining = False
+        self.retired = False
         self.outstanding = 0
         self.consecutive_fails = 0
         self.generation: Optional[int] = None
@@ -87,6 +93,8 @@ class Replica:
                 f"{self.host}:{self.port}" if self.port is not None else None
             ),
             "healthy": self.healthy,
+            "draining": self.draining,
+            "retired": self.retired,
             "outstanding": self.outstanding,
             "generation": self.generation,
             "quant": self.quant,
@@ -120,7 +128,61 @@ class RouterMetrics:
         # every one is a cold rebuild and MUST be measurable
         self.session_migrations = 0
         self.request_latency = LatencyHistogram()
+        # windowed series for the autoscaler (ISSUE 16): arrival
+        # timestamps + (t, latency) samples over a bounded deque, so
+        # the control loop reads RECENT rate/p99 — the cumulative
+        # histogram above can never recover after a spike
+        from collections import deque
+
+        self._arrivals: deque = deque(maxlen=8192)
+        self._latencies: deque = deque(maxlen=8192)
+        # per-class admission ledger: class -> {"admitted", "shed"}
+        self.admission: Dict[str, Dict[str, int]] = {}
         REGISTRY.register_source("router", self)
+
+    def note_arrival(self) -> None:
+        with self._lock:
+            self._arrivals.append(time.monotonic())
+
+    def note_latency(self, latency_s: float) -> None:
+        with self._lock:
+            self._latencies.append((time.monotonic(), float(latency_s)))
+
+    def note_admission(self, cls: str, verdict: str) -> None:
+        """One admission verdict: the per-class ledger (rides
+        ``/metrics.json``) plus the registry counter
+        ``router_admission{class=,verdict=}``."""
+        with self._lock:
+            entry = self.admission.setdefault(
+                cls, {"admitted": 0, "shed": 0}
+            )
+            entry[verdict] = entry.get(verdict, 0) + 1
+        REGISTRY.counter(
+            "router_admission", **{"class": cls, "verdict": verdict}
+        ).inc()
+
+    def _windowed_locked(self, window_s: float) -> Dict[str, Any]:
+        now = time.monotonic()
+        arrivals = sum(1 for t in self._arrivals if now - t <= window_s)
+        lats = sorted(
+            dt for t, dt in self._latencies if now - t <= window_s
+        )
+        return {
+            "window_s": window_s,
+            "rate_rps": round(arrivals / max(window_s, 1e-9), 3),
+            "p99_ms": (
+                round(lats[int(0.99 * (len(lats) - 1))] * 1000.0, 3)
+                if lats else None
+            ),
+            "samples": len(lats),
+        }
+
+    def windowed(self, window_s: float = 5.0) -> Dict[str, Any]:
+        """Arrival rate + exact p99 over the last ``window_s`` seconds
+        — the autoscaler's observation and the smoke's recovery
+        check."""
+        with self._lock:
+            return self._windowed_locked(window_s)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -135,6 +197,10 @@ class RouterMetrics:
                 "rolls": self.rolls,
                 "session_migrations": self.session_migrations,
                 "request_latency": self.request_latency.snapshot(),
+                "admission": {
+                    cls: dict(v) for cls, v in self.admission.items()
+                },
+                "window": self._windowed_locked(5.0),
             }
 
     def inc(self, field: str, n: int = 1, event: Optional[str] = None) -> None:
@@ -178,11 +244,17 @@ class Router:
         watch: Optional[str] = None,
         watch_interval_s: float = 2.0,
         quant_ab: float = 0.0,
+        admission=None,
     ):
         from .. import chaos
 
         self.pool = pool
         self.portfile_for = portfile_for
+        # SLO admission control (autoscale/admission.py): None = admit
+        # everything (the historical behavior); an AdmissionPolicy
+        # sheds per class at the front door (429 batch / 503
+        # interactive), verdicts counted via RouterMetrics
+        self.admission = admission
         if pool is not None:
             n = replicas if isinstance(replicas, int) else len(replicas)
             self.replicas = [Replica(i) for i in range(n)]
@@ -312,6 +384,7 @@ class Router:
                         trace_header=self.headers.get("X-Sparknet-Trace"),
                         path=self.path,
                         session=self.headers.get("X-Sparknet-Session"),
+                        cls=self.headers.get("X-Sparknet-Class"),
                     )
                     self._send(
                         code, payload, "application/json", headers
@@ -369,6 +442,7 @@ class Router:
             ready = [
                 r for r in self.replicas
                 if r.healthy and r.port is not None
+                and not r.draining and not r.retired
                 and r.index not in exclude
             ]
             if prefer_quant is not None:
@@ -398,6 +472,7 @@ class Router:
             rep = self.replicas[index]
             if (
                 rep.healthy and rep.port is not None
+                and not rep.draining and not rep.retired
                 and rep.index not in exclude
             ):
                 rep.outstanding += 1
@@ -448,6 +523,7 @@ class Router:
     def dispatch(
         self, body: bytes, trace_header: Optional[str] = None,
         path: str = "/classify", session: Optional[str] = None,
+        cls: Optional[str] = None,
     ) -> Tuple[int, bytes, list]:
         """Forward one /classify or /generate body; retries on peers
         until a replica answers (anything but a connection failure /
@@ -481,8 +557,56 @@ class Router:
         from ..telemetry import reqtrace
 
         self.metrics.inc("requests")
+        self.metrics.note_arrival()
         t0 = time.perf_counter()
         rctx = reqtrace.parse(trace_header) or reqtrace.mint()
+        # ---- SLO admission control (ISSUE 16): shed at the front
+        # door, batch class first, BEFORE any replica sees the body.
+        # A shed still leaves a full forensic trail: its router.shed
+        # span closes the trace and the X-Sparknet-Trace header rides
+        # the refusal.
+        if self.admission is not None:
+            from ..telemetry import anomaly as _anomaly
+            from ..autoscale.admission import normalize_class
+
+            cls_name = normalize_class(cls)
+            with self._lock:
+                outstanding = sum(
+                    r.outstanding for r in self.replicas if not r.retired
+                )
+                healthy = sum(
+                    1 for r in self.replicas
+                    if r.healthy and not r.draining and not r.retired
+                )
+            verdict, shed_code, reason = self.admission.check(
+                cls_name,
+                burn=bool(_anomaly.active("slo_burn")),
+                outstanding=outstanding,
+                healthy=healthy,
+            )
+            if verdict == "shed":
+                self.metrics.note_admission(cls_name, "shed")
+                hop = reqtrace.hop(rctx, "router.shed")
+                hop.finish(
+                    outcome="shed", reason=reason,
+                    **{"class": cls_name, "status": shed_code},
+                )
+                hdrs = [(
+                    "Retry-After",
+                    str(max(1, int(self.admission.retry_after_s))),
+                )]
+                if rctx is not None:
+                    reqtrace.finish(rctx, time.perf_counter() - t0)
+                    hdrs.append(
+                        (reqtrace.HEADER, reqtrace.to_header(rctx))
+                    )
+                payload = json.dumps({
+                    "error": "shed by admission control",
+                    "class": cls_name,
+                    "reason": reason,
+                }).encode()
+                return shed_code, payload, hdrs
+            self.metrics.note_admission(cls_name, "admitted")
         # the A/B draw is per REQUEST, not per attempt: a retried
         # request keeps its variant preference (and may still fall
         # back to the other group when its own is down)
@@ -588,6 +712,7 @@ class Router:
                 ).inc()
             dt = time.perf_counter() - t0
             self._done(rep, dt)
+            self.metrics.note_latency(dt)
             self.metrics.request_latency.observe(
                 dt,
                 exemplar=(
@@ -630,7 +755,7 @@ class Router:
 
     # --------------------------------------------------------------- health
     def _probe(self, rep: Replica) -> None:
-        if rep.port is None:
+        if rep.retired or rep.port is None:
             return
         try:
             status, payload, _ = self._replica_request(
@@ -667,7 +792,7 @@ class Router:
         if self.pool is None:
             return
         for child, rep in zip(self.pool.children, self.replicas):
-            if child.spawn_count == 0:
+            if rep.retired or child.spawn_count == 0:
                 continue
             path = self.portfile_for(child.index, child.spawn_count - 1)
             try:
@@ -786,7 +911,7 @@ class Router:
     ) -> bool:
         """Block until ``n`` replicas (default: all) answer healthy —
         the CLI's serve-traffic gate and the tests' barrier."""
-        want = len(self.replicas) if n is None else int(n)
+        want = self.active_width() if n is None else int(n)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             # only tick ourselves when no health thread is running —
@@ -801,10 +926,106 @@ class Router:
             time.sleep(min(0.2, self.health_interval_s))
         return False
 
+    # ------------------------------------------------------- scale surface
+    # The autoscale controller (autoscale/controller.py) drives these.
+    # Replica index stays aligned with the pool's child index forever:
+    # a retired slot is parked (retired=True), never removed, and
+    # scale-up reuses the lowest parked slot via pool.rearm() before
+    # appending fresh width via pool.add_child().
+
+    def active_width(self) -> int:
+        """Replicas that count toward the tier's width (draining
+        included — they still hold sessions — retired excluded)."""
+        with self._lock:
+            return sum(1 for r in self.replicas if not r.retired)
+
+    def healthy_count(self) -> int:
+        """Replicas able to take NEW work right now."""
+        with self._lock:
+            return sum(
+                1 for r in self.replicas
+                if r.healthy and not r.draining and not r.retired
+            )
+
+    def scale_up(self) -> Optional[int]:
+        """Grow the tier by one replica (pool mode only).  Reuses the
+        lowest retired slot when one exists, else appends a fresh pool
+        child; the next health tick spawns it and discovers its port.
+        Returns the replica index, or None when scaling is impossible
+        (static address list — there is no process to spawn)."""
+        if self.pool is None:
+            return None
+        with self._lock:
+            parked = [r.index for r in self.replicas if r.retired]
+            if parked:
+                idx = parked[0]
+                if not self.pool.rearm(idx):
+                    return None  # old process still exiting; next look
+                rep = self.replicas[idx]
+                rep.retired = False
+                rep.draining = False
+                rep.healthy = False
+                rep.port = None
+                rep.pid = None
+                rep.consecutive_fails = 0
+                return idx
+            child = self.pool.add_child()
+            self.replicas.append(Replica(child.index))
+            return child.index
+
+    def pick_drain_victim(self) -> Optional[int]:
+        """The replica a scale-down should drain: highest index that
+        is active and not already draining (highest first keeps the
+        low indices stable — they are the tier's permanent floor)."""
+        with self._lock:
+            for r in reversed(self.replicas):
+                if not r.retired and not r.draining:
+                    return r.index
+        return None
+
+    def begin_drain(self, index: int) -> bool:
+        """Stop routing NEW work at replica ``index``; in-flight work
+        finishes and its held sessions migrate through the counted
+        affinity-failover path (the holder entries are deliberately
+        KEPT — ``_pick_holder`` fails over to a peer and
+        ``_note_session`` records the ``session_migrate`` event, so
+        no state moves silently)."""
+        with self._lock:
+            rep = self.replicas[index]
+            if rep.retired or rep.draining:
+                return False
+            rep.draining = True
+            return True
+
+    def replica_drained(self, index: int) -> bool:
+        """True once replica ``index`` has no in-flight work."""
+        with self._lock:
+            return self.replicas[index].outstanding <= 0
+
+    def retire_replica(self, index: int) -> bool:
+        """Park replica ``index`` (its process is stopped through the
+        pool's deliberate-retire path — STOPPED, not a crash).  The
+        slot stays in the list so pool/replica index alignment holds;
+        scale_up() re-arms it first."""
+        with self._lock:
+            rep = self.replicas[index]
+            if rep.retired:
+                return False
+            rep.retired = True
+            rep.draining = False
+            rep.healthy = False
+            rep.port = None
+            rep.pid = None
+        if self.pool is not None:
+            self.pool.retire(index)
+        return True
+
     def healthz(self) -> Dict[str, Any]:
         with self._lock:
             reps = [r.snapshot() for r in self.replicas]
         healthy = sum(1 for r in reps if r["healthy"])
+        active = sum(1 for r in reps if not r["retired"])
+        draining = sum(1 for r in reps if r["draining"])
         gens = {r["generation"] for r in reps if r["healthy"]}
         quants = {r["quant"] for r in reps if r["healthy"]}
         with self._lock:
@@ -814,13 +1035,16 @@ class Router:
             "sessions_tracked": sessions_tracked,
             "quants": sorted(q for q in quants if q is not None),
             "status": (
-                "ok" if healthy == len(reps)
+                # retired slots are deliberate absences, not outages
+                "ok" if healthy == active
                 else "degraded" if healthy else "down"
             ),
             "role": "router",
             "model": self.model_name,
             "replicas_healthy": healthy,
             "replicas_total": len(reps),
+            "replicas_active": active,
+            "replicas_draining": draining,
             "generations": sorted(g for g in gens if g is not None),
             "replicas": reps,
         }

@@ -759,17 +759,24 @@ class Client:
         """The JSON snapshot (the Prometheus text lives at /metrics)."""
         return self._request("GET", "/metrics.json")
 
-    def classify(self, rows, top_k: int = 5, trace: Optional[str] = None):
+    def classify(self, rows, top_k: int = 5, trace: Optional[str] = None,
+                 cls: Optional[str] = None):
         """``trace``: an ``X-Sparknet-Trace`` header value (see
         ``telemetry/reqtrace.py``) — lets a caller mint the trace
         context client-side so it can correlate its own latency record
         with the tier's stitched waterfall.  Retries reuse the same
-        trace id (a retried request is still one request)."""
+        trace id (a retried request is still one request).  ``cls``:
+        the ``X-Sparknet-Class`` admission class (``"batch"`` =
+        sheddable throughput traffic; absent = interactive)."""
         rows = np.asarray(rows)
-        headers = {reqtrace.HEADER: trace} if trace else None
+        headers = {}
+        if trace:
+            headers[reqtrace.HEADER] = trace
+        if cls:
+            headers["X-Sparknet-Class"] = str(cls)
         return self._request(
             "POST", "/classify", {"rows": rows.tolist(), "top_k": top_k},
-            headers=headers,
+            headers=headers or None,
         )
 
     def generate(
@@ -779,6 +786,7 @@ class Client:
         steps: int = 0,
         top_k: int = 5,
         trace: Optional[str] = None,
+        cls: Optional[str] = None,
     ):
         """Session-aware autoregressive decode (``POST /generate``).
         ``tokens`` is the session's FULL prefix (self-contained
@@ -790,6 +798,8 @@ class Client:
             headers[reqtrace.HEADER] = trace
         if session:
             headers["X-Sparknet-Session"] = str(session)
+        if cls:
+            headers["X-Sparknet-Class"] = str(cls)
         payload = {
             "tokens": [int(t) for t in np.asarray(tokens).ravel()],
             "steps": int(steps),

@@ -57,6 +57,7 @@ class Child:
     __slots__ = (
         "index", "name", "proc", "state", "policy", "spawn_count",
         "next_spawn_t", "last_spawn_t", "last_exit", "give_up_reason",
+        "stop_deadline_t",
     )
 
     def __init__(self, index: int, name: str, cfg: Config):
@@ -70,6 +71,9 @@ class Child:
         self.last_spawn_t: Optional[float] = None
         self.last_exit: Optional[int] = None
         self.give_up_reason: Optional[str] = None
+        # retire() grace: a STOPPED child still alive past this gets
+        # SIGKILL from the next tick
+        self.stop_deadline_t: Optional[float] = None
 
     @property
     def pid(self) -> Optional[int]:
@@ -156,10 +160,23 @@ class ChildPool:
         events since the last tick (spawn/exit/give_up), newest last —
         the owner's log/metrics feed."""
         now = time.monotonic()
-        for child in self.children:
+        for child in list(self.children):
+            if child.state == STOPPED and child.proc is not None:
+                # deliberate stop (retire()/stop()): reap the exit
+                # quietly — no event, no policy — and escalate to
+                # SIGKILL past the retire grace
+                if child.proc.poll() is None:
+                    if (child.stop_deadline_t is not None
+                            and now >= child.stop_deadline_t):
+                        child.proc.kill()
+                        child.stop_deadline_t = None
+                continue
             if child.state == RUNNING:
                 rc = child.proc.poll()
                 if rc is None:
+                    continue
+                if child.state != RUNNING:
+                    # retire() raced the poll: the stop was deliberate
                     continue
                 child.last_exit = rc
                 cls = classify_exit(rc)
@@ -193,6 +210,56 @@ class ChildPool:
                 self._spawn(child)
         out, self.events = self.events, []
         return out
+
+    # --------------------------------------------------- elastic width
+    def add_child(self) -> Child:
+        """Append one fresh child slot (the autoscaler's grow path).
+        Spawned by the next ``tick()``/``start()`` — non-blocking,
+        like everything else here."""
+        child = Child(
+            len(self.children), f"{self.name}-{len(self.children)}",
+            self.cfg,
+        )
+        self.children.append(child)
+        self.events.append({"event": "add", "child": child.index})
+        return child
+
+    def rearm(self, index: int) -> bool:
+        """Bring a STOPPED/GIVEN_UP slot back (scale-up reusing a
+        retired slot): fresh policy budget — a deliberate re-add is a
+        new deployment, not a continuation of old failures.  False
+        when the child is still up or mid-backoff."""
+        child = self.children[index]
+        if child.state not in (STOPPED, GIVEN_UP):
+            return False
+        if child.proc is not None and child.proc.poll() is None:
+            return False  # old process still exiting; try next tick
+        child.policy = RestartPolicy(self.cfg)
+        child.give_up_reason = None
+        child.stop_deadline_t = None
+        child.state = BACKOFF
+        child.next_spawn_t = 0.0
+        self.events.append({"event": "rearm", "child": index})
+        return True
+
+    def retire(self, index: int, grace_s: float = 10.0) -> bool:
+        """Deliberately stop child ``index`` (the autoscaler's shrink
+        path): parks it ``STOPPED`` — the tick will never respawn it —
+        then SIGTERM, with SIGKILL escalation after ``grace_s`` via
+        the tick.  The state flips BEFORE the signal so a racing tick
+        classifies the exit as deliberate, not a crash."""
+        child = self.children[index]
+        if child.state not in (RUNNING, BACKOFF):
+            return False
+        child.state = STOPPED
+        child.stop_deadline_t = time.monotonic() + grace_s
+        if child.proc is not None and child.proc.poll() is None:
+            try:
+                child.proc.terminate()
+            except OSError:
+                pass
+        self.events.append({"event": "retire", "child": index})
+        return True
 
     # ------------------------------------------------------------------
     def kill(self, index: int, sig: int = signal.SIGKILL) -> bool:

@@ -293,6 +293,7 @@ class SloBurnRateDetector:
         slow_burn: float = 0.25,
         min_samples: int = 5,
         refire_s: float = 30.0,
+        ttl_s: Optional[float] = None,
         emit=print,
         now=time.monotonic,
     ):
@@ -312,6 +313,10 @@ class SloBurnRateDetector:
         self.slow_burn = slow_burn
         self.min_samples = int(min_samples)
         self.refire_s = refire_s
+        # advisory lifetime per fire; a control loop that needs the
+        # advisory to CLEAR promptly after recovery (the autoscaler's
+        # scale-down gate) passes a short ttl with refire_s <= ttl_s
+        self.ttl_s = float(ttl_s) if ttl_s is not None else DEFAULT_TTL_S
         self.emit = emit
         self._now = now
         self._samples: deque = deque(maxlen=16384)
@@ -338,6 +343,7 @@ class SloBurnRateDetector:
             "slo_burn",
             key="p99",
             severity="critical",
+            ttl_s=self.ttl_s,
             emit=self.emit,
             p99_ms=round(float(p99_ms), 3),
             slo_ms=self.slo_ms,

@@ -66,6 +66,18 @@ def main(argv=None):
              "(docs/QUANTIZATION.md)",
     )
     ap.add_argument(
+        "--autoscale-max", type=int, default=0, metavar="N",
+        help="router mode: enable the autoscale control loop "
+             "(autoscale/controller.py) — --replicas is the floor, N "
+             "the ceiling; 0 disables autoscaling (static width)",
+    )
+    ap.add_argument(
+        "--admission", choices=["auto", "on", "off"], default="auto",
+        help="router mode: per-class SLO admission control at the "
+             "front door (batch sheds 429 first; auto: on exactly "
+             "when --autoscale-max is set)",
+    )
+    ap.add_argument(
         "--run-dir", default=None,
         help="router mode: where portfiles/logs land (default: a "
              "temp dir)",
@@ -100,6 +112,12 @@ def main(argv=None):
         if args.replicas < 2:
             ap.error("--quant-ab needs --replicas >= 2 (at least one "
                      "replica per variant)")
+
+    if args.autoscale_max and args.autoscale_max < max(args.replicas, 1):
+        ap.error("--autoscale-max must be >= --replicas (it is the "
+                 "ceiling, --replicas the floor)")
+    if args.autoscale_max and args.replicas < 1:
+        ap.error("--autoscale-max needs router mode (--replicas >= 1)")
 
     if args.replicas > 0:
         return _run_router(args)
@@ -194,6 +212,15 @@ def _run_router(args):
         args.replicas,
         name="serve-replica",
     )
+    admit_on = (
+        args.admission == "on"
+        or (args.admission == "auto" and args.autoscale_max > 0)
+    )
+    admission = None
+    if admit_on:
+        from ..autoscale.admission import AdmissionPolicy
+
+        admission = AdmissionPolicy()
     router = Router(
         args.replicas,
         pool=pool,
@@ -204,9 +231,24 @@ def _run_router(args):
         health_interval_s=args.health_interval_s,
         watch=args.snapshot_watch,
         quant_ab=getattr(args, "quant_ab", 0.0),
+        admission=admission,
     )
+    controller = None
+    if args.autoscale_max > 0:
+        from ..autoscale.controller import AutoscaleController
+        from ..autoscale.policy import AutoscalePolicy
+
+        controller = AutoscaleController(
+            router,
+            AutoscalePolicy(
+                min_replicas=args.replicas,
+                max_replicas=args.autoscale_max,
+            ),
+        )
     pool.start()
     router.start()
+    if controller is not None:
+        controller.start()
     if args.portfile:
         # reuse the replica portfile shape; the router has no engine
         write_portfile(
@@ -214,11 +256,16 @@ def _run_router(args):
             type("E", (), {"warmup_s": None, "generation": 0})(), None,
         )
     ok = router.wait_healthy(timeout_s=300.0)
+    auto = (
+        f", autoscale {args.replicas}..{args.autoscale_max}"
+        if controller is not None else ""
+    )
     print(
         f"router on http://{router.host}:{router.port} — "
         f"{len(pool.alive())}/{args.replicas} replicas "
         f"{'healthy' if ok else 'NOT all healthy'} "
-        f"(run_dir={run_dir})",
+        f"(run_dir={run_dir}"
+        f"{auto}{', admission on' if admission else ''})",
         flush=True,
     )
     try:
@@ -229,6 +276,8 @@ def _run_router(args):
     except KeyboardInterrupt:
         pass
     finally:
+        if controller is not None:
+            controller.stop()
         router.stop()
     return router
 

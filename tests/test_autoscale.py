@@ -1,0 +1,739 @@
+"""Traffic-shaped autoscaling + SLO admission control (ISSUE 16).
+
+Fast pins for the autoscale subsystem: deterministic open-loop traffic
+schedules (same (script, seed) ⇒ byte-identical arrivals), the pure
+scale policy replayed against synthetic (rate, p99, burn) series, the
+per-class admission verdicts (batch sheds first, with trace headers on
+the refusal), the child pool's elastic width (retire/rearm/add), the
+router's drain path (held sessions migrate COUNTED, never silently),
+and the control loop driven against a fake router.  The expensive
+subprocess e2e (real replicas, a real 10x spike, scale-up + recovery +
+scale-down) lives in scripts/autoscale_smoke.py (check.sh).
+"""
+
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from sparknet_tpu.autoscale.admission import AdmissionPolicy, normalize_class
+from sparknet_tpu.autoscale.controller import AutoscaleController
+from sparknet_tpu.autoscale.policy import AutoscalePolicy
+from sparknet_tpu.autoscale.traffic import (
+    arrivals,
+    parse_script,
+    rate_at,
+    schedule,
+)
+from sparknet_tpu.serve.router import Router
+from sparknet_tpu.telemetry import anomaly
+
+
+def _silent(*a, **k):
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _clean_advisories():
+    anomaly.clear()
+    anomaly.reset_detectors()
+    yield
+    anomaly.clear()
+    anomaly.reset_detectors()
+
+
+# ------------------------------------------------------- traffic shapes
+def test_traffic_script_shapes_and_rates():
+    segs = parse_script("flat:rate=4,dur=10")
+    assert len(segs) == 1 and segs[0].dur == 10 and segs[0].peak == 4
+    # spike: base outside [warm, warm+burst), base*mult inside
+    s = "spike:base=2,mult=10,warm=5,burst=3,cool=2"
+    assert rate_at(s, 0.0) == 2 and rate_at(s, 4.99) == 2
+    assert rate_at(s, 5.0) == 20 and rate_at(s, 7.99) == 20
+    assert rate_at(s, 8.0) == 2 and rate_at(s, 99.0) == 0.0
+    # ramp endpoints, sine floor at zero
+    r = "ramp:lo=2,hi=12,dur=10"
+    assert rate_at(r, 0.0) == 2 and abs(rate_at(r, 5.0) - 7.0) < 1e-9
+    assert rate_at("sine:mean=1,amp=9,period=4,dur=8", 3.0) == 0.0
+    # composed scripts run back to back on one absolute clock
+    comp = "flat:rate=1,dur=2;flat:rate=7,dur=2"
+    assert rate_at(comp, 1.0) == 1 and rate_at(comp, 3.0) == 7
+
+
+def test_traffic_script_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown shape"):
+        parse_script("sawtooth:rate=1,dur=1")
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_script("flat:rte=1,dur=1")
+    with pytest.raises(ValueError, match="must be a number"):
+        parse_script("flat:rate=fast,dur=1")
+    with pytest.raises(ValueError, match="dur must be > 0"):
+        parse_script("flat:rate=1,dur=0")
+    with pytest.raises(ValueError, match="empty script"):
+        parse_script(" ; ")
+
+
+def test_arrivals_deterministic_per_seed():
+    """The satellite bar: two runs of the same traffic script produce
+    IDENTICAL arrival timestamps; a different seed produces different
+    ones; the realized count tracks the scripted volume."""
+    s = "spike:base=20,mult=5,warm=2,burst=2,cool=1"
+    t1, d1 = arrivals(s, seed=42)
+    t2, d2 = arrivals(s, seed=42)
+    assert t1 == t2 and d1 == d2 == 5.0
+    t3, _ = arrivals(s, seed=43)
+    assert t1 != t3
+    assert all(0.0 <= t < 5.0 for t in t1)
+    assert t1 == sorted(t1)
+    # expected volume: 20*2 (warm) + 100*2 (burst) + 20*1 (cool) = 260
+    assert 170 < len(t1) < 350
+
+
+def test_schedule_classes_and_sessions_deterministic():
+    s = "flat:rate=150,dur=2"
+    p1 = schedule(s, seed=7, batch_frac=0.5, sessions=16, session_zipf=1.4)
+    p2 = schedule(s, seed=7, batch_frac=0.5, sessions=16, session_zipf=1.4)
+    assert p1.times == p2.times
+    assert p1.classes == p2.classes
+    assert p1.session_ids == p2.session_ids
+    # classes/sessions ride a SECOND stream: adding them never perturbs
+    # the arrival clock itself
+    assert p1.times == arrivals(s, seed=7)[0]
+    n_batch = p1.classes.count("batch")
+    assert 0 < n_batch < len(p1)
+    assert 0.3 < n_batch / len(p1) < 0.7
+    # Zipf skew: rank-0 session is the hottest
+    counts = [p1.session_ids.count(k) for k in range(16)]
+    assert counts[0] == max(counts) and counts[0] > counts[-1]
+    assert len(p1) > 0 and p1.offered_rate() > 0
+
+
+# ------------------------------------------------------- scale policy
+def _policy(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("slo_ms", 100.0)
+    kw.setdefault("up_looks", 2)
+    kw.setdefault("down_looks", 2)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 5.0)
+    kw.setdefault("down_frac", 0.5)
+    return AutoscalePolicy(**kw)
+
+
+def test_policy_up_needs_streak_cooldown_and_ceiling():
+    clock = [0.0]
+    pol = _policy(now=lambda: clock[0])
+    look = dict(rate_rps=50.0, p99_ms=400.0, healthy=1, width=1)
+    d = pol.decide(**look)
+    assert d["action"] == "hold" and "streak" in d["reason"]
+    d = pol.decide(**look)
+    assert d["action"] == "up"
+    # the streak resets on fire and the cooldown blocks a re-fire
+    d = pol.decide(**{**look, "width": 2})
+    d = pol.decide(**{**look, "width": 2})
+    assert d["action"] == "hold" and d["reason"] == "up cooldown"
+    clock[0] += 6.0
+    assert pol.decide(**{**look, "width": 2})["action"] == "up"
+    # at the ceiling a breach can only hold
+    clock[0] += 6.0
+    pol.decide(**{**look, "width": 3})
+    d = pol.decide(**{**look, "width": 3})
+    assert d["action"] == "hold" and "max_replicas" in d["reason"]
+
+
+def test_policy_burn_advisory_alone_scales_up():
+    clock = [0.0]
+    pol = _policy(now=lambda: clock[0])
+    look = dict(rate_rps=10.0, p99_ms=20.0, healthy=1, width=1, burn=True)
+    pol.decide(**look)
+    d = pol.decide(**look)
+    assert d["action"] == "up" and "slo_burn" in d["reason"]
+
+
+def test_policy_down_needs_learned_capacity_calm_streak_and_floor():
+    clock = [0.0]
+    pol = _policy(now=lambda: clock[0])
+    calm = dict(rate_rps=2.0, p99_ms=20.0, healthy=2, width=2)
+    # no learned capacity yet: never down, no matter how calm
+    for _ in range(5):
+        assert pol.decide(**calm)["action"] == "hold"
+    assert pol.per_replica_rps == 1.0  # rate/healthy observed so far
+    # a busy-but-healthy look teaches real per-replica capacity
+    pol.decide(rate_rps=20.0, p99_ms=60.0, healthy=2, width=2)
+    assert pol.per_replica_rps == 10.0
+    # rate 2 fits 0.5 * 10 * 1 = 5: two calm looks then down
+    pol.decide(**calm)
+    d = pol.decide(**calm)
+    assert d["action"] == "down", d
+    # at the floor the same calm series only holds
+    at_floor = dict(rate_rps=2.0, p99_ms=20.0, healthy=1, width=1)
+    clock[0] += 10.0
+    for _ in range(4):
+        assert pol.decide(**at_floor)["action"] == "hold"
+
+
+def test_policy_idle_tier_shrinks_to_the_floor():
+    """No traffic at all (rate 0, no latency samples) is calm — a tier
+    left wide after a spike must come back down even when the traffic
+    stops entirely (but never without learned capacity)."""
+    clock = [0.0]
+    pol = _policy(now=lambda: clock[0])
+    idle = dict(rate_rps=0.0, p99_ms=None, healthy=2, width=2)
+    for _ in range(4):
+        assert pol.decide(**idle)["action"] == "hold"  # capacity unknown
+    # the calm streak built during those looks; with capacity known
+    # the very next idle look shrinks
+    pol.per_replica_rps = 10.0
+    assert pol.decide(**idle)["action"] == "down"
+
+
+def test_policy_never_shrinks_on_the_heels_of_a_grow():
+    clock = [0.0]
+    pol = _policy(now=lambda: clock[0], down_cooldown_s=8.0)
+    pol.per_replica_rps = 10.0
+    breach = dict(rate_rps=30.0, p99_ms=400.0, healthy=1, width=1)
+    pol.decide(**breach)
+    assert pol.decide(**breach)["action"] == "up"
+    calm = dict(rate_rps=1.0, p99_ms=10.0, healthy=2, width=2)
+    clock[0] += 2.0
+    pol.decide(**calm)
+    d = pol.decide(**calm)
+    assert d["action"] == "hold" and d["reason"] == "recent scale-up"
+    clock[0] += 10.0  # past the post-up window: the streak is already
+    # built, so the next calm look shrinks
+    assert pol.decide(**calm)["action"] == "down"
+
+
+def test_policy_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="down_frac"):
+        AutoscalePolicy(down_frac=0.0)
+    with pytest.raises(ValueError, match="> 0"):
+        AdmissionPolicy(max_outstanding_per_replica=0)
+    with pytest.raises(ValueError, match="hard_factor"):
+        AdmissionPolicy(hard_factor=0.5)
+
+
+# --------------------------------------------------- admission verdicts
+def test_admission_batch_sheds_before_interactive():
+    pol = AdmissionPolicy(max_outstanding_per_replica=4, hard_factor=2)
+    assert normalize_class(None) == "interactive"
+    assert normalize_class(" Batch ") == "batch"
+    assert normalize_class("weird") == "interactive"
+    # burn live: batch 429s while interactive is still admitted
+    v = pol.check("batch", burn=True, outstanding=0, healthy=2)
+    assert v == ("shed", 429, "slo_burn")
+    v = pol.check("interactive", burn=True, outstanding=0, healthy=2)
+    assert v == ("admit", None, None)
+    # queue pressure (cap = 4*2 = 8): batch first, interactive only at
+    # hard_factor x the cap
+    v = pol.check("batch", burn=False, outstanding=8, healthy=2)
+    assert v == ("shed", 429, "queue_pressure")
+    v = pol.check("interactive", burn=False, outstanding=8, healthy=2)
+    assert v == ("admit", None, None)
+    v = pol.check("interactive", burn=False, outstanding=16, healthy=2)
+    assert v == ("shed", 503, "overload")
+    # nothing healthy: admit — dispatch owns the all-down 503
+    v = pol.check("batch", burn=True, outstanding=99, healthy=0)
+    assert v == ("admit", None, None)
+
+
+# ------------------------------------------------------- stub replicas
+class _Stub:
+    """Scriptable replica speaking /classify, /generate and /healthz —
+    enough surface for router-level admission and drain tests."""
+
+    def __init__(self):
+        self.served = []
+        self.gen_sessions = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(200, {"status": "ok", "generation": 0,
+                                  "warmup_s": 0.1, "pid": None})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/generate":
+                    sid = req.get("session")
+                    outer.gen_sessions.append(sid)
+                    steps = int(req.get("steps", 0))
+                    self._reply(200, {
+                        "tokens": [1] * steps, "probs": [[1.0]] * steps,
+                        "session": sid, "cache_state": "hit", "gen": 0,
+                    })
+                    return
+                rid = int(req["rows"][0][0])
+                outer.served.append(rid)
+                self._reply(200, {
+                    "indices": [[rid]], "probs": [[1.0]], "gen": 0,
+                })
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stub_tier():
+    a, b = _Stub(), _Stub()
+    router = Router(
+        [(a.host, a.port), (b.host, b.port)],
+        model_name="stub", health_interval_s=0.1,
+        admission=AdmissionPolicy(
+            max_outstanding_per_replica=4, hard_factor=2
+        ),
+    )
+    assert router.wait_healthy(timeout_s=10)
+    yield a, b, router
+    router.stop()
+    a.stop()
+    b.stop()
+
+
+def test_router_sheds_batch_on_burn_with_trace_headers(stub_tier):
+    """The admission-path satellite: a burn-rate trip sheds batch (429
+    + Retry-After) while interactive still serves; the refusal carries
+    ``X-Sparknet-Trace``; shed/admit counts land in the snapshot."""
+    from sparknet_tpu.telemetry import reqtrace
+
+    a, b, router = stub_tier
+    reqtrace.reset()
+    reqtrace.enable()
+    try:
+        anomaly.fire("slo_burn", key="p99", emit=_silent)
+        body = json.dumps({"rows": [[3.0]]}).encode()
+        code, payload, headers = router.dispatch(body, cls="batch")
+        hdrs = dict(headers)
+        assert code == 429
+        doc = json.loads(payload)
+        assert doc["reason"] == "slo_burn" and doc["class"] == "batch"
+        assert hdrs.get("Retry-After")
+        assert hdrs.get("X-Sparknet-Trace"), "shed lost its trace"
+        # the shed request's trace completed, with the router.shed span
+        done = reqtrace.completed(5)
+        assert any(
+            s["name"] == "router.shed"
+            for rec in done for s in rec["spans"]
+        )
+        # interactive traffic flows regardless of the advisory
+        code, payload, _ = router.dispatch(body, cls="interactive")
+        assert code == 200
+        code, payload, _ = router.dispatch(body)  # no class header
+        assert code == 200
+        adm = router.metrics.snapshot()["admission"]
+        assert adm["batch"]["shed"] == 1
+        assert adm["interactive"]["admitted"] == 2
+        assert not a.served or not b.served or True  # served somewhere
+    finally:
+        reqtrace.reset()
+        reqtrace.disable()
+
+
+def test_router_admission_clears_with_the_advisory(stub_tier):
+    a, b, router = stub_tier
+    anomaly.fire("slo_burn", key="p99", ttl_s=0.05, emit=_silent)
+    body = json.dumps({"rows": [[1.0]]}).encode()
+    code, _, _ = router.dispatch(body, cls="batch")
+    assert code == 429
+    time.sleep(0.1)  # the advisory expires; batch flows again
+    code, _, _ = router.dispatch(body, cls="batch")
+    assert code == 200
+    snap = router.metrics.snapshot()
+    assert snap["admission"]["batch"] == {"admitted": 1, "shed": 1}
+
+
+def test_router_windowed_metrics_track_rate_and_p99(stub_tier):
+    a, b, router = stub_tier
+    body = json.dumps({"rows": [[1.0]]}).encode()
+    for _ in range(20):
+        code, _, _ = router.dispatch(body)
+        assert code == 200
+    w = router.metrics.windowed(10.0)
+    assert w["samples"] == 20
+    assert w["rate_rps"] == pytest.approx(2.0, abs=0.01)
+    assert w["p99_ms"] is not None and w["p99_ms"] > 0
+    assert router.metrics.snapshot()["window"]["window_s"] == 5.0
+
+
+def test_router_drain_migrates_sessions_counted(stub_tier):
+    """The scale-down bar: draining the replica that holds sessions
+    routes them to a peer through the COUNTED migration path — the
+    response is stamped migrated, ``session_migrations`` increments,
+    and the drained replica empties without ever going unhealthy."""
+    a, b, router = stub_tier
+    body = json.dumps(
+        {"tokens": [1, 2], "steps": 1, "session": "hot"}
+    ).encode()
+    code, payload, _ = router.dispatch(
+        body, path="/generate", session="hot"
+    )
+    assert code == 200
+    holder = router._session_holder("hot")
+    assert holder is not None
+    # affinity holds while the holder is up
+    for _ in range(3):
+        code, payload, _ = router.dispatch(
+            body, path="/generate", session="hot"
+        )
+        assert code == 200
+        assert router._session_holder("hot") == holder
+    before = router.metrics.snapshot()["session_migrations"]
+    assert router.begin_drain(holder)
+    assert not router.begin_drain(holder)  # idempotence guard
+    code, payload, _ = router.dispatch(
+        body, path="/generate", session="hot"
+    )
+    assert code == 200
+    doc = json.loads(payload)
+    assert doc.get("migrated") is True
+    new_holder = router._session_holder("hot")
+    assert new_holder is not None and new_holder != holder
+    assert (
+        router.metrics.snapshot()["session_migrations"] == before + 1
+    )
+    # the drained replica has no in-flight work: retire it
+    assert router.replica_drained(holder)
+    assert router.retire_replica(holder)
+    assert router.active_width() == 1
+    hz = router.healthz()
+    assert hz["replicas_active"] == 1 and hz["replicas_draining"] == 0
+    assert hz["replicas_total"] == 2  # the slot is parked, not deleted
+    # classify traffic keeps flowing on the survivor
+    code, _, _ = router.dispatch(json.dumps({"rows": [[9.0]]}).encode())
+    assert code == 200
+
+
+# --------------------------------------------------- elastic child pool
+def _fast_cfg(**kw):
+    from sparknet_tpu.supervise.policy import Config
+
+    kw.setdefault("backoff_s", 0.01)
+    kw.setdefault("max_backoff_s", 0.02)
+    kw.setdefault("flap_window_s", 9999.0)
+    kw.setdefault("healthy_s", 9999.0)
+    return Config(**kw)
+
+
+def _wait(pred, timeout=30.0, tick=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if tick is not None:
+            tick()
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_pool_retire_rearm_and_add():
+    from sparknet_tpu.supervise.pool import RUNNING, STOPPED, ChildPool
+
+    pool = ChildPool(
+        lambda i, s: [sys.executable, "-c", "import time; time.sleep(60)"],
+        2, config=_fast_cfg(max_restarts=5),
+    ).start()
+    try:
+        # retire: deliberate stop — state flips, the tick reaps the
+        # exit quietly (no crash event, no respawn)
+        assert pool.retire(1, grace_s=5.0)
+        assert pool.children[1].state == STOPPED
+        assert not pool.retire(1)  # already down
+        assert _wait(
+            lambda: pool.children[1].proc.poll() is not None,
+            tick=lambda: pool.tick(),
+        )
+        events = pool.tick()
+        assert pool.children[1].state == STOPPED
+        assert all(e["event"] != "exit" or e["child"] != 1
+                   for e in events)
+        spawns_before = pool.children[1].spawn_count
+        # rearm: the retired slot comes back with a FRESH budget
+        assert pool.rearm(1)
+        assert _wait(
+            lambda: pool.children[1].state == RUNNING,
+            tick=lambda: pool.tick(),
+        )
+        assert pool.children[1].spawn_count == spawns_before + 1
+        assert not pool.rearm(1)  # running: nothing to re-arm
+        # add: a third slot, spawned by the next tick
+        child = pool.add_child()
+        assert child.index == 2 and len(pool.children) == 3
+        assert _wait(
+            lambda: pool.children[2].state == RUNNING,
+            tick=lambda: pool.tick(),
+        )
+        assert len(pool.alive()) == 3
+    finally:
+        pool.stop()
+
+
+def test_pool_retire_escalates_to_kill_past_grace():
+    """A child that ignores SIGTERM is SIGKILLed by the tick once the
+    retire grace expires."""
+    from sparknet_tpu.supervise.pool import ChildPool
+
+    pool = ChildPool(
+        lambda i, s: [
+            sys.executable, "-c",
+            "import signal, time; "
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+            "time.sleep(60)",
+        ],
+        1, config=_fast_cfg(),
+    ).start()
+    try:
+        _wait(lambda: pool.children[0].proc is not None
+              and pool.children[0].proc.poll() is None)
+        time.sleep(0.2)  # let the child install its handler
+        assert pool.retire(0, grace_s=0.3)
+        assert _wait(
+            lambda: pool.children[0].proc.poll() is not None,
+            timeout=15.0, tick=lambda: pool.tick(),
+        ), "retire never escalated past SIGTERM"
+    finally:
+        pool.stop()
+
+
+# ------------------------------------------------------ the control loop
+class _FakeRouter:
+    """The router's scale surface as a scriptable fake: windowed
+    metrics are set by the test, scale actions mutate plain counters."""
+
+    class _M:
+        def __init__(self):
+            self.obs = {"window_s": 5.0, "rate_rps": 0.0,
+                        "p99_ms": None, "samples": 0}
+
+        def windowed(self, window_s):
+            return dict(self.obs)
+
+    def __init__(self, width=1):
+        self.metrics = self._M()
+        self.width = width
+        self.draining = set()
+        self.retired = []
+        self.drained = set()   # indices whose outstanding hit zero
+
+    def active_width(self):
+        return self.width
+
+    def healthy_count(self):
+        return self.width - len(self.draining)
+
+    def scale_up(self):
+        self.width += 1
+        return self.width - 1
+
+    def pick_drain_victim(self):
+        for i in reversed(range(self.width)):
+            if i not in self.draining:
+                return i
+        return None
+
+    def begin_drain(self, idx):
+        self.draining.add(idx)
+        return True
+
+    def replica_drained(self, idx):
+        return idx in self.drained
+
+    def retire_replica(self, idx):
+        self.draining.discard(idx)
+        self.retired.append(idx)
+        self.width -= 1
+        return True
+
+
+class _NoBurn:
+    def observe(self, p99_ms):
+        return None
+
+
+def test_controller_scales_up_then_drains_down():
+    clock = [100.0]
+    router = _FakeRouter(width=1)
+    pol = _policy(now=lambda: clock[0], up_cooldown_s=0.0,
+                  down_cooldown_s=0.0)
+    ctl = AutoscaleController(
+        router, pol, interval_s=0.1, window_s=5.0, drain_timeout_s=30.0,
+        burn_detector=_NoBurn(), emit=_silent, now=lambda: clock[0],
+    )
+    # breach series: p99 over SLO for two looks -> one scale-up
+    router.metrics.obs.update(rate_rps=40.0, p99_ms=400.0, samples=50)
+    ctl.look()
+    d = ctl.look()
+    assert d["action"] == "up" and router.width == 2
+    assert ctl.scale_ups == 1
+    # healthy + busy: capacity learned; then calm -> drain begins
+    clock[0] += 1.0
+    router.metrics.obs.update(rate_rps=40.0, p99_ms=50.0)
+    ctl.look()
+    assert pol.per_replica_rps == 20.0
+    router.metrics.obs.update(rate_rps=3.0, p99_ms=20.0)
+    ctl.look()
+    d = ctl.look()
+    assert d["action"] == "down"
+    assert router.draining == {1} and router.width == 2
+    assert ctl.snapshot()["draining"] == [1]
+    # while draining, no second drain starts; once the replica is
+    # empty the next look retires it
+    d = ctl.look()
+    assert router.draining == {1}
+    router.drained.add(1)
+    ctl.look()
+    assert router.retired == [1] and router.width == 1
+    assert ctl.scale_downs == 1 and ctl.drains_forced == 0
+
+
+def test_controller_forces_a_stuck_drain_past_the_timeout():
+    clock = [100.0]
+    router = _FakeRouter(width=2)
+    pol = _policy(now=lambda: clock[0], up_cooldown_s=0.0,
+                  down_cooldown_s=0.0)
+    pol.per_replica_rps = 20.0
+    ctl = AutoscaleController(
+        router, pol, interval_s=0.1, window_s=5.0, drain_timeout_s=2.0,
+        burn_detector=_NoBurn(), emit=_silent, now=lambda: clock[0],
+    )
+    router.metrics.obs.update(rate_rps=2.0, p99_ms=10.0, samples=50)
+    ctl.look()
+    d = ctl.look()
+    assert d["action"] == "down" and router.draining == {1}
+    # the replica never empties: past the deadline it is retired anyway
+    clock[0] += 1.0
+    ctl.look()
+    assert router.retired == []
+    clock[0] += 2.0
+    ctl.look()
+    assert router.retired == [1]
+    assert ctl.drains_forced == 1
+
+
+def test_controller_burn_detector_drives_the_advisory():
+    """End-to-end inside one process: a windowed p99 breach series
+    fires ``slo_burn`` through the controller's own detector, and the
+    advisory expires shortly after the series recovers (short ttl —
+    the scale-down gate must be able to clear)."""
+    clock = [500.0]
+    router = _FakeRouter(width=4)
+    pol = _policy(max_replicas=4, now=lambda: clock[0])
+    ctl = AutoscaleController(
+        router, pol, interval_s=1.0, window_s=5.0,
+        emit=_silent, now=lambda: clock[0],
+    )
+    assert ctl._burn.ttl_s == 3.0  # 3x the refire cadence
+    router.metrics.obs.update(rate_rps=10.0, p99_ms=999.0, samples=9)
+    for _ in range(6):  # past min_samples on both burn windows
+        ctl.look()
+        clock[0] += 1.0
+    assert anomaly.active("slo_burn"), "burn series never fired"
+    # NOTE: the detector clock is real time.monotonic (the advisory
+    # board's expiry is too) — recovery here is the *real* ttl elapsing
+    router.metrics.obs.update(p99_ms=10.0)
+    deadline = time.monotonic() + 10.0
+    while anomaly.active("slo_burn") and time.monotonic() < deadline:
+        ctl.look()
+        time.sleep(0.2)
+    assert not anomaly.active("slo_burn"), "advisory never cleared"
+
+
+# ------------------------------------------------------ open-loop loadgen
+def test_open_loadgen_fires_on_the_clock_and_counts_classes():
+    from sparknet_tpu.serve.loadgen import run_open_loadgen
+
+    stub = _Stub()
+    try:
+        rec = run_open_loadgen(
+            stub.host, stub.port, (1,),
+            script="flat:rate=40,dur=1.5", seed=11,
+            batch_frac=0.4, slo_ms=500.0, timeout_s=10.0,
+        )
+    finally:
+        stub.stop()
+    plan = schedule("flat:rate=40,dur=1.5", seed=11, batch_frac=0.4)
+    assert rec["offered"] == len(plan)
+    assert rec["failed_requests"] == 0
+    assert rec["client_overflow"] == 0
+    assert rec["metric"] == "serve_open_loop_slo_ok_frac"
+    cls = rec["classes"]
+    assert set(cls) == {"batch", "interactive"}
+    for c in cls.values():
+        assert c["offered"] == c["ok"] + c["shed"] + c["failed"]
+        assert c["shed"] == 0
+    assert (cls["batch"]["offered"]
+            == sum(1 for c in plan.classes if c == "batch"))
+    # a healthy stub answers instantly: the SLO fraction is perfect
+    assert rec["value"] == 1.0
+    assert rec["classes"]["interactive"]["p99_ms"] is not None
+    assert rec["lateness_p99_ms"] is not None
+    assert rec["duration_s"] == 1.5
+
+
+def test_open_loadgen_session_mode_appends_history_on_success():
+    from sparknet_tpu.serve.loadgen import run_open_loadgen
+
+    stub = _Stub()
+    try:
+        rec = run_open_loadgen(
+            stub.host, stub.port, (1,),
+            script="flat:rate=30,dur=1", seed=3,
+            sessions=4, session_zipf=1.2, session_steps=1,
+            slo_ms=500.0, timeout_s=10.0,
+        )
+    finally:
+        stub.stop()
+    assert rec["session_failed_requests"] == 0
+    assert rec["failed_requests"] == 0
+    assert rec["sessions"]["count"] == 4
+    assert 1 <= rec["sessions"]["distinct"] <= 4
+    assert stub.gen_sessions, "no /generate traffic reached the stub"
+    assert rec["classes"]["interactive"]["ok"] == rec["offered"]
+
+
+def test_open_loadgen_batch_class_is_sessionless_generate():
+    """Session-mode tiers (char-rnn) serve only ``/generate`` — the
+    batch class must ride it sessionless, never ``/classify``."""
+    from sparknet_tpu.serve.loadgen import run_open_loadgen
+
+    stub = _Stub()
+    try:
+        rec = run_open_loadgen(
+            stub.host, stub.port, (1,),
+            script="flat:rate=30,dur=1", seed=5,
+            batch_frac=0.5, sessions=4, session_steps=1,
+            slo_ms=500.0, timeout_s=10.0,
+        )
+    finally:
+        stub.stop()
+    assert rec["failed_requests"] == 0
+    assert rec["session_failed_requests"] == 0
+    b = rec["classes"]["batch"]
+    assert b["offered"] > 0 and b["ok"] == b["offered"]
+    assert not stub.served, "batch leaked onto /classify"
+    # batch = sessionless generate; interactive steps carry session ids
+    assert any(s is None for s in stub.gen_sessions)
+    assert any(s is not None for s in stub.gen_sessions)
