@@ -264,6 +264,35 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
             add("session_bit_identical", None, float(bool(bi)), "",
                 not bi,
                 "ok" if bi else "hit-vs-cold answers DIFFER")
+        # batched-decode arm (ISSUE 17): K sessions sharing one step
+        # dispatch must beat one-at-a-time decode on aggregate
+        # tokens/sec by the floor.  A throughput ratio, so CPU records
+        # gate informationally (speedup_gate — the PR 12 honest-
+        # labeling discipline); the batched-vs-serial continuation
+        # match is ABSOLUTE on every platform.
+        b = new.get("batched_tokens_per_sec_speedup")
+        if b is not None:
+            gated = new.get("speedup_gate") != "informational-on-cpu"
+            low = gated and b < args.decode_speedup_min
+            add("batched_tokens_per_sec_speedup",
+                old.get("batched_tokens_per_sec_speedup"), b, "", low,
+                f"≥{args.decode_speedup_min:g}x floor" if low
+                else ("cpu-informational" if not gated else "ok"))
+        # the device-side ratio (tokens stepped per engine-second) is
+        # overhead-immune, so it gates on EVERY backend — this is the
+        # CPU-honest form of the ≥3x batching claim
+        d = new.get("batched_device_speedup")
+        if d is not None:
+            low = d < args.decode_speedup_min
+            add("batched_device_speedup",
+                old.get("batched_device_speedup"), d, "", low,
+                f"≥{args.decode_speedup_min:g}x floor" if low else "ok")
+        tm = new.get("batched_tokens_match")
+        if tm is not None:
+            add("batched_tokens_match", None, float(bool(tm)), "",
+                not tm,
+                "ok" if tm
+                else "batched-vs-serial continuations DIFFER")
     b = find_key(new, "session_failed_requests")
     if b is not None:
         a = find_key(old, "session_failed_requests")
@@ -376,6 +405,12 @@ def main(argv=None) -> int:
                          "scheduling the tier cannot control; the "
                          "hard evidence is the zero-failure bars and "
                          "the positive gap vs the static arm)")
+    ap.add_argument("--decode-speedup-min", type=float, default=3.0,
+                    help="batched-decode aggregate tokens/sec floor vs "
+                         "one-at-a-time decode, x (session_serving "
+                         "records; accelerator records only — CPU "
+                         "records carry speedup_gate="
+                         "informational-on-cpu; default 3)")
     ap.add_argument("--session-speedup-min", type=float, default=5.0,
                     help="session-cache cached-vs-cold per-request "
                          "latency floor, x (session_serving records; "

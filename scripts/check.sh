@@ -383,6 +383,19 @@ else
   exit 1
 fi
 
+# ---- decode batch smoke (ISSUE 17): 4 concurrent sessions drive
+# /generate through the continuous token-level batcher (K rows per
+# compiled step dispatch) while the state-holding replica is SIGKILLed
+# mid-burst — zero failed requests, every batched row must equal its
+# one-at-a-time serial replay exactly (tokens/probs/indices), and the
+# tier's healthz decode block must show the batched path ran.
+if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/decode_batch_smoke.py; then
+  echo "check.sh: decode batch smoke OK (4-session burst + holder kill, 0 failed, rows == serial replay)"
+else
+  echo "check.sh: decode batch SMOKE FAILED"
+  exit 1
+fi
+
 # ---- autoscale smoke (ISSUE 16): a 1-replica char-rnn tier with
 # --autoscale-max 2 takes a seeded 12x open-loop spike — the controller
 # must scale 1->2 on the windowed-p99 breach, admission must shed batch

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Batched-decode smoke (ISSUE 17 satellite, run by scripts/check.sh).
+
+The continuous token-level batching story in one short CPU run:
+
+1. boot a 1-router / 2-replica tier on the char-rnn decoder with
+   decode batching ON (the default);
+2. drive 4 CONCURRENT sessions through ``/generate`` in lockstep
+   rounds (a barrier per round, so the tier actually sees overlapping
+   decode requests sharing batched step windows);
+3. SIGKILL whichever replica holds session state MID-burst: every
+   remaining request must still answer (peer retry + cold rebuild) —
+   ZERO failed requests is the bar;
+4. serially replay every recorded step as a fresh sessionless request
+   (one row at a time through the SAME batched decode loop) and
+   assert per-row equality: tokens, probs and indices of the batched
+   burst must equal the serial replay exactly, padded rows and
+   batch-mates notwithstanding;
+5. assert the tier's healthz decode block shows the batched path ran
+   (batching on, dispatches > 0) and that hit-path replies stepped
+   only their new tokens (``steps_run`` == steps asked).
+
+Exit 0 on success; any assertion prints the evidence and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+DEPLOY = os.path.join(
+    REPO, "sparknet_tpu", "models", "prototxt", "char_rnn_deploy.prototxt"
+)
+
+N_SESSIONS = 4
+N_ROUNDS = 5
+KILL_AFTER_ROUND = 1  # strike once round 0 and 1 built resident state
+
+
+def wait_for(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.3)
+    raise SystemExit(f"decode batch smoke: timed out waiting for {what}")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("SPARKNET_DECODE_BATCH", None)  # the default: ON
+    tmp = tempfile.mkdtemp(prefix="decode_batch_smoke_")
+    portfile = os.path.join(tmp, "router.json")
+    log = open(os.path.join(tmp, "tier.log"), "w")
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sparknet_tpu.tools.serve",
+         "--model", DEPLOY,
+         "--replicas", "2", "--port", "0", "--buckets", "1",
+         "--portfile", portfile,
+         "--run-dir", os.path.join(tmp, "run")],
+        cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        wait_for(
+            lambda: os.path.exists(portfile) or proc.poll() is not None,
+            300, "router portfile",
+        )
+        if proc.poll() is not None:
+            print(open(log.name).read()[-3000:])
+            raise SystemExit("decode batch smoke: tier died at boot")
+        doc = json.load(open(portfile))
+
+        from sparknet_tpu.serve.server import Client
+
+        client = Client(doc["host"], doc["port"], timeout=60, retries=4)
+
+        def healthy2():
+            try:
+                _, hz = client.healthz()
+                return hz if hz.get("replicas_healthy") == 2 else None
+            except Exception:
+                return None
+
+        wait_for(healthy2, 300, "2 healthy replicas")
+
+        # 4 sessions with distinct prefixes (vocab 0..95)
+        prefixes = [
+            [ord(c) - 32 for c in f"spark row {w}"]
+            for w in range(N_SESSIONS)
+        ]
+        hists = [list(p) for p in prefixes]
+        # replies[w][r] = (prefix sent, reply dict) for session w round r
+        replies = [[None] * N_ROUNDS for _ in range(N_SESSIONS)]
+        failures = []
+        # every worker + the main (chaos) thread syncs twice per round,
+        # so the 4 session requests of a round are genuinely in flight
+        # together — the overlap the batched windows coalesce
+        barrier = threading.Barrier(N_SESSIONS + 1, timeout=300)
+
+        def worker(w: int) -> None:
+            wclient = Client(
+                doc["host"], doc["port"], timeout=60, retries=4
+            )
+            for r in range(N_ROUNDS):
+                barrier.wait()
+                try:
+                    sent = list(hists[w])
+                    st, resp = wclient.generate(
+                        sent, session=f"burst-{w}", steps=1
+                    )
+                    if st != 200:
+                        raise RuntimeError(
+                            f"HTTP {st}: {resp.get('error')}"
+                        )
+                    if len(resp.get("tokens", ())) != 1:
+                        raise RuntimeError(f"bad tokens: {resp}")
+                    if resp.get("cache_state") == "hit" and (
+                        resp.get("steps_run") != 1
+                    ):
+                        raise RuntimeError(
+                            f"hit stepped {resp.get('steps_run')} "
+                            f"times, not 1 (padded rows counted?): "
+                            f"{resp}"
+                        )
+                    replies[w][r] = (sent, resp)
+                    hists[w] = sent + [int(t) for t in resp["tokens"]]
+                except Exception as e:
+                    failures.append(
+                        f"session {w} round {r}: "
+                        f"{type(e).__name__}: {e}"
+                    )
+                barrier.wait()
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(N_SESSIONS)
+        ]
+        for t in threads:
+            t.start()
+
+        victim = None
+        for r in range(N_ROUNDS):
+            barrier.wait()  # round r fires
+            barrier.wait()  # round r done
+            if r == KILL_AFTER_ROUND:
+                # strike the replica holding session state MID-burst
+                def find_holder():
+                    try:
+                        _, hz = client.healthz()
+                    except Exception:
+                        return None
+                    got = [
+                        rep for rep in hz["replicas"]
+                        if (rep.get("session_cache") or {}).get(
+                            "entries", 0
+                        ) > 0
+                    ]
+                    return got or None
+
+                holders = wait_for(find_holder, 60, "a session holder")
+                victim = holders[0]["pid"]
+                os.kill(victim, signal.SIGKILL)
+        for t in threads:
+            t.join(300)
+
+        assert not failures, (
+            "failed requests during the batched burst "
+            f"(ZERO is the bar): {failures}"
+        )
+        assert victim is not None, "no holder was ever resident"
+
+        # ---- serial replay: every step again, one row at a time, as
+        # a sessionless cold rebuild through the same batched decode
+        # loop — per-row equality regardless of batch-mates/padding
+        mismatches = []
+        for w in range(N_SESSIONS):
+            for r in range(N_ROUNDS):
+                sent, burst = replies[w][r]
+                st, solo = client.generate(list(sent), steps=1)
+                if st != 200:
+                    mismatches.append(
+                        f"session {w} round {r}: replay HTTP {st}"
+                    )
+                    continue
+                for key in ("tokens", "probs", "indices"):
+                    if burst[key] != solo[key]:
+                        mismatches.append(
+                            f"session {w} round {r} {key}: "
+                            f"batched {burst[key]} != serial {solo[key]}"
+                        )
+        assert not mismatches, (
+            "batched rows differ from serial replay:\n  "
+            + "\n  ".join(mismatches[:10])
+        )
+
+        # ---- the batched path actually ran: surviving replicas'
+        # healthz decode block shows batching on + dispatches
+        _, hz = client.healthz()
+        decode_blocks = [
+            rep.get("decode") for rep in hz["replicas"]
+            if rep.get("decode")
+        ]
+        assert decode_blocks, f"no replica exported a decode block: {hz}"
+        assert all(d.get("batching") for d in decode_blocks), (
+            f"decode batching not on: {decode_blocks}"
+        )
+        dispatches = sum(
+            int(d.get("dispatches", 0)) for d in decode_blocks
+        )
+        rows = sum(int(d.get("rows", 0)) for d in decode_blocks)
+        assert dispatches > 0, (
+            f"no batched decode dispatches ran: {decode_blocks}"
+        )
+
+        migrated = sum(
+            1 for w in range(N_SESSIONS) for r in range(N_ROUNDS)
+            if replies[w][r][1].get("migrated")
+        )
+        print(
+            "decode batch smoke: OK — "
+            f"{N_SESSIONS} concurrent sessions x {N_ROUNDS} rounds "
+            f"survived a mid-burst holder SIGKILL with 0 failures; "
+            f"{N_SESSIONS * N_ROUNDS} rows == serial replay; "
+            f"decode dispatches={dispatches} rows={rows} "
+            f"migrated_replies={migrated}"
+        )
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        log.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

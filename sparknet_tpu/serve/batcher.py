@@ -49,6 +49,7 @@ before the engine call to make both paths testable.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -67,6 +68,15 @@ from ..telemetry import trace as _trace
 _EWMA_ALPHA = 0.3
 
 
+def decode_batching_enabled() -> bool:
+    """The ISSUE 17 A/B flag: ``SPARKNET_DECODE_BATCH=0`` keeps the
+    PR 13 serial decode path (one ``engine.generate`` per worker turn)
+    as the baseline; default on routes ``/generate`` through
+    :meth:`MicroBatcher.submit_decode` and the batched token loop."""
+    raw = os.environ.get("SPARKNET_DECODE_BATCH", "1").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
 class Backpressure(RuntimeError):
     """Raised by submit() when the bounded request queue is full."""
 
@@ -77,17 +87,20 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("rows", "n", "future", "t_enq", "deadline", "ctx", "fn")
+    __slots__ = ("rows", "n", "future", "t_enq", "deadline", "ctx", "fn",
+                 "decode")
 
     def __init__(self, rows: Optional[np.ndarray],
                  deadline_s: Optional[float] = None,
-                 ctx=None, fn=None):
-        # either a rows request (coalescable into engine batches) or a
-        # callable request (``submit_call`` — e.g. a session decode):
-        # both share the queue, the FIFO order, backpressure, and the
+                 ctx=None, fn=None, decode=None):
+        # a rows request (coalescable into engine batches), a callable
+        # request (``submit_call``), or a decode request (``submit_
+        # decode`` — a dict riding the batched token loop): all three
+        # share the queue, the FIFO order, backpressure, and the
         # deadline-shed machinery
         self.rows = rows
         self.fn = fn
+        self.decode = decode
         self.n = 1 if rows is None else len(rows)
         self.future: Future = Future()
         self.t_enq = time.perf_counter()
@@ -144,6 +157,11 @@ class MicroBatcher:
         self._last_arrival_t: Optional[float] = None
         self._service_s: Dict[int, float] = {}
         self._q: "queue.Queue[_Pending]" = queue.Queue(maxsize=max_queue)
+        # one item the decode window's admitter pulled but must not run
+        # (the first non-decode item ends continuous admission so total
+        # FIFO order holds); the worker loop consumes it before the
+        # next queue get
+        self._stash: Optional[_Pending] = None
         self._open = True
         self._worker = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
@@ -220,6 +238,41 @@ class MicroBatcher:
             self.metrics.set_queue_depth(self._q.qsize())
         return item.future
 
+    def submit_decode(
+        self,
+        request: dict,
+        *,
+        block: bool = False,
+        timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        ctx=None,
+    ) -> Future:
+        """Enqueue one decode request (``{"tokens": [...], "session":
+        id?, "steps": K, "top_k": k}``) for the continuous batched
+        token loop (``engine.decode_batch``).  FIFO position, back-
+        pressure and deadlines work exactly like ``submit``/``submit_
+        call``, but consecutive decode requests — and any that arrive
+        while a decode window is running — share ONE window: K live
+        sessions per dispatch instead of one ``generate`` per worker
+        turn.  The future resolves the moment the request's row
+        retires, not at window end."""
+        if not self._open:
+            raise RuntimeError("MicroBatcher is drained/closed")
+        item = _Pending(
+            None,
+            self.deadline_s if deadline_s is None else deadline_s,
+            ctx, decode=dict(request),
+        )
+        try:
+            self._q.put(item, block=block, timeout=timeout)
+        except queue.Full:
+            raise Backpressure(
+                f"request queue full ({self._q.maxsize} pending)"
+            ) from None
+        if self.metrics is not None:
+            self.metrics.set_queue_depth(self._q.qsize())
+        return item.future
+
     # ----------------------------------------------------- estimators
     def _note_arrival(self, item: _Pending) -> None:
         """Arrival-rate EWMA (rows/s) over inter-arrival gaps — the
@@ -270,12 +323,15 @@ class MicroBatcher:
             else self._gather_fill
         )
         while True:
-            try:
-                first = self._q.get(timeout=0.05)
-            except queue.Empty:
-                if not self._open:
-                    return
-                continue
+            if self._stash is not None:
+                first, self._stash = self._stash, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    if not self._open:
+                        return
+                    continue
             batch, total = gather(first)
             if self.metrics is not None:
                 self.metrics.set_queue_depth(self._q.qsize())
@@ -398,19 +454,28 @@ class MicroBatcher:
                     it.ctx, "batcher.wait", it.t_enq,
                     rows=it.n, mode=self.mode,
                 )
-        # callable requests (submit_call — session decode) run in queue
-        # position: split the batch into maximal rows runs and calls,
-        # preserving FIFO — a rows run coalesces into one engine batch
-        # exactly as before, a call runs alone
-        if any(it.fn is not None for it in batch):
+        # non-rows requests run in queue position: split the batch into
+        # maximal same-kind runs, preserving FIFO — a rows run
+        # coalesces into one engine batch exactly as before, a call
+        # runs alone, and a DECODE run becomes one continuous batched
+        # token window (K sessions per dispatch, ISSUE 17)
+        if any(it.fn is not None or it.decode is not None for it in batch):
             i = 0
             while i < len(batch):
-                if batch[i].fn is not None:
+                if batch[i].decode is not None:
+                    j = i
+                    while j < len(batch) and batch[j].decode is not None:
+                        j += 1
+                    self._run_decode(batch[i:j])
+                    i = j
+                elif batch[i].fn is not None:
                     self._run_call(batch[i])
                     i += 1
                 else:
                     j = i
-                    while j < len(batch) and batch[j].fn is None:
+                    while j < len(batch) and (
+                        batch[j].fn is None and batch[j].decode is None
+                    ):
                         j += 1
                     self._run_rows(
                         batch[i:j], sum(it.n for it in batch[i:j])
@@ -447,6 +512,109 @@ class MicroBatcher:
                     if it.ctx is not None and it.ctx.sampled else None
                 ),
             )
+
+    def _run_decode(self, items: List[_Pending]) -> None:
+        """One continuous batched-decode window: the items (already
+        shed/cancel-filtered by ``_run``) seed ``engine.decode_batch``;
+        while the window runs, further decode arrivals are admitted
+        straight off the queue at step boundaries — continuous batching
+        — until the first NON-decode item, which is stashed so total
+        FIFO order holds (under decode-heavy load the queue is all
+        decode and admission never closes).  Each item's future
+        resolves the moment its row retires, so per-request latency is
+        honest under continuous batching."""
+        outstanding: Dict[int, _Pending] = {}
+
+        def as_req(it: _Pending) -> dict:
+            req = dict(it.decode)
+            req["tag"] = id(it)
+            req["deadline"] = it.deadline
+            outstanding[id(it)] = it
+            return req
+
+        reqs = [as_req(it) for it in items]
+        closed = [False]
+
+        def admit(slots: int):
+            if closed[0]:
+                return ()
+            got: List[dict] = []
+            while len(got) < int(slots):
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt.decode is None:
+                    # first non-decode item ends admission for this
+                    # window (FIFO); the worker loop resumes with it
+                    self._stash = nxt
+                    closed[0] = True
+                    break
+                if not nxt.future.set_running_or_notify_cancel():
+                    if self.metrics is not None:
+                        self.metrics.record_cancelled(1)
+                    continue
+                if nxt.ctx is not None:
+                    _reqtrace.record_interval(
+                        nxt.ctx, "batcher.wait", nxt.t_enq,
+                        rows=1, mode="decode",
+                    )
+                got.append(as_req(nxt))
+            if self.metrics is not None:
+                self.metrics.set_queue_depth(self._q.qsize())
+            return got
+
+        def on_result(tag: int, value) -> None:
+            it = outstanding.pop(tag)
+            now = time.perf_counter()
+            if isinstance(value, Exception):
+                if isinstance(value, DeadlineExceeded):
+                    if self.metrics is not None:
+                        self.metrics.record_shed(1)
+                    if it.ctx is not None:
+                        _reqtrace.record_interval(
+                            it.ctx, "batcher.shed", it.t_enq,
+                            reason="deadline", rows=1,
+                        )
+                elif self.metrics is not None:
+                    self.metrics.record_error()
+                if not it.future.cancelled():
+                    it.future.set_exception(value)
+                return
+            if it.ctx is not None:
+                # the request's slot on the stitched waterfall:
+                # enqueue -> row retirement, tagged with the REAL step
+                # count its row paid for
+                _reqtrace.record_interval(
+                    it.ctx, "engine.decode_batch", it.t_enq, now,
+                    steps=value.get("steps_run"),
+                    cache_state=value.get("cache_state"),
+                )
+            if not it.future.cancelled():
+                it.future.set_result(value)
+            if self.metrics is not None:
+                lat = now - it.t_enq
+                self.metrics.record_request(
+                    lat, rows=1,
+                    exemplar=(
+                        (it.ctx.trace_id, lat)
+                        if it.ctx is not None and it.ctx.sampled else None
+                    ),
+                )
+
+        try:
+            self.engine.decode_batch(
+                reqs, admit=admit, on_result=on_result
+            )
+        except Exception as e:
+            # window-level failure (per-row errors arrive via
+            # on_result): fail whatever is still outstanding
+            if self.metrics is not None and outstanding:
+                self.metrics.record_error(len(outstanding))
+            for it in outstanding.values():
+                if not it.future.cancelled():
+                    it.future.set_exception(e)
+            outstanding.clear()
 
     def _run_rows(self, batch: List[_Pending], total: int) -> None:
         t0 = time.perf_counter()

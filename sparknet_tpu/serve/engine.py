@@ -32,9 +32,11 @@ produces "donated buffer unused" noise.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple, Union
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -47,6 +49,66 @@ from . import session as _session
 from .compile_cache import net_fingerprint
 
 Rows = Union[np.ndarray, Dict[str, np.ndarray]]
+
+# batched-decode widths (ISSUE 17): the compiled step executables the
+# continuous token-level batcher dispatches through.  The floor is 4,
+# not 1, deliberately: XLA CPU compiles the width-1 step with a
+# different fusion whose results differ from the batched widths at the
+# ulp level, while widths >= 4 are mutually bitwise row-independent
+# (pinned by test) — so a lone session pads to 4 and per-row answers
+# stay bitwise stable across any batch occupancy.
+DECODE_BUCKETS_DEFAULT = (4, 8, 16)
+
+
+def decode_buckets_from_env() -> Tuple[int, ...]:
+    """``SPARKNET_DECODE_BUCKETS`` (e.g. ``"4,8"``) -> sorted widths;
+    the default ladder when unset."""
+    raw = os.environ.get("SPARKNET_DECODE_BUCKETS", "").strip()
+    if not raw:
+        return DECODE_BUCKETS_DEFAULT
+    widths = tuple(sorted({int(w) for w in raw.split(",") if w.strip()}))
+    if not widths or widths[0] < 4:
+        # the floor is load-bearing: widths below 4 compile to
+        # fusion whose rows are NOT bitwise stable vs the ladder
+        raise ValueError(
+            f"SPARKNET_DECODE_BUCKETS={raw!r}: want ints >= 4 "
+            "(narrower steps break cross-width bitwise row stability)"
+        )
+    return widths
+
+
+class _DecodeRow:
+    """One live session row inside a ``decode_batch`` window."""
+
+    __slots__ = ("tag", "slot", "session", "tokens", "steps", "top_k",
+                 "deadline", "carry", "out", "pos", "generated",
+                 "cache_state", "steps_run")
+
+    def __init__(self, tag, slot, session, tokens, steps, top_k,
+                 deadline, carry, out, pos, cache_state):
+        self.tag = tag
+        self.slot = slot
+        self.session = session
+        self.tokens = tokens          # canonical full prefix
+        self.steps = steps            # tokens to greedy-decode beyond it
+        self.top_k = top_k
+        self.deadline = deadline      # absolute perf_counter, or None
+        self.carry = carry            # per-row (1, h) leaf tree
+        self.out = out                # last step output, (1, ...) rows
+        self.pos = pos                # prefix tokens already incorporated
+        self.generated: List[int] = []
+        self.cache_state = cache_state
+        self.steps_run = 0            # REAL steps this request paid for
+
+    @property
+    def n_prefix(self) -> int:
+        return int(self.tokens.shape[0])
+
+    def finished(self) -> bool:
+        return (
+            self.pos >= self.n_prefix
+            and len(self.generated) >= self.steps
+        )
 
 
 def load_weights_any(net, params, state, weights: str):
@@ -168,6 +230,12 @@ class InferenceEngine:
             self._stepper = _session.DecodeStepper(
                 net, self.output, compute_dtype=self.compute_dtype
             )
+        # batched-decode width ladder (only meaningful with a stepper);
+        # compiled lazily on first batched dispatch so replica boot cost
+        # stays flat — warmup still compiles only the width-1 step
+        self.decode_buckets: Tuple[int, ...] = (
+            decode_buckets_from_env() if self._stepper is not None else ()
+        )
         self.session_cache = (
             _session.make_session_cache()
             if self._stepper is not None else _session.DISABLED
@@ -371,11 +439,34 @@ class InferenceEngine:
         t0 = time.perf_counter()
         if self._stepper is not None:
             self._step_executable()
+            from .batcher import decode_batching_enabled
+
+            if decode_batching_enabled():
+                self._warm_decode_ladder()
         else:
             for b in self.buckets:
                 self._executable(b)
         self.warmup_s = round(time.perf_counter() - t0, 3)
         return self
+
+    def _warm_decode_ladder(self) -> None:
+        """Compile AND run one throwaway step at every batched-decode
+        width.  A window that forms at a width nobody warmed would pay
+        the compile — and the first-execution runtime init, ~2 orders
+        above steady state — inside live rows' latency budgets.
+        Side-effect free: touches no session cache or metrics."""
+        if self._stepper is None:
+            return
+        weights = self._weights_snapshot()
+        params, state, _, _ = weights
+        stepper = self._stepper
+        for w in self.decode_buckets:
+            exe = self._step_executable(w, weights)
+            tok = jnp.zeros(
+                (w,) + stepper.row_shape, jnp.dtype(stepper.token_dtype)
+            )
+            out, _ = exe(params, state, stepper.init_carry(w), tok)
+            jax.block_until_ready(out)
 
     # ------------------------------------------------------------------
     def _as_batch(self, rows: Rows) -> Dict[str, np.ndarray]:
@@ -508,32 +599,11 @@ class InferenceEngine:
             self._step_cache[key] = exe
         return exe
 
-    def generate(
-        self,
-        tokens,
-        *,
-        session: Optional[str] = None,
-        steps: int = 0,
-        top_k: int = 5,
-    ) -> Dict[str, Any]:
-        """Multi-step autoregressive decode — the session-aware serving
-        entry point (``POST /generate``).
-
-        ``tokens``: the session's FULL token prefix (requests are
-        self-contained; the cache is an optimization, never a
-        correctness dependency).  ``session``: a session id — with one,
-        the per-session carry cache skips the already-processed prefix
-        (O(new tokens) instead of O(prefix)); without one (or on any
-        miss) the prefix replays through the same compiled step, so hit
-        and cold answers are bit-identical by construction.  ``steps``:
-        how many tokens to greedy-decode beyond the prefix.
-
-        Returns one JSON-able dict: generated ``tokens``, final-step
-        ``indices``/``probs`` (top-k), the weights ``gen``,
-        ``cache_state`` (hit/cold/stale_gen/rebuilt/disabled),
-        ``session_tokens`` (prefix incorporated so far) and
-        ``steps_run`` (tokens actually stepped — the O(1)-vs-O(prefix)
-        cost, observable per response)."""
+    def _decode_prep(self, tokens, steps: int):
+        """Canonicalize + validate a decode request's (tokens, steps) —
+        the shared gate of :meth:`generate` and :meth:`decode_batch`
+        (identical errors on both paths, so the A/B flag never changes
+        what a bad request sees)."""
         if self._stepper is None:
             raise ValueError(
                 "generate: model has no recurrent layer — serve a "
@@ -563,6 +633,36 @@ class InferenceEngine:
                 "generate: steps>0 needs a token-id net (Embed input) "
                 "to feed generated ids back"
             )
+        return tokens, steps
+
+    def generate(
+        self,
+        tokens,
+        *,
+        session: Optional[str] = None,
+        steps: int = 0,
+        top_k: int = 5,
+    ) -> Dict[str, Any]:
+        """Multi-step autoregressive decode — the session-aware serving
+        entry point (``POST /generate``).
+
+        ``tokens``: the session's FULL token prefix (requests are
+        self-contained; the cache is an optimization, never a
+        correctness dependency).  ``session``: a session id — with one,
+        the per-session carry cache skips the already-processed prefix
+        (O(new tokens) instead of O(prefix)); without one (or on any
+        miss) the prefix replays through the same compiled step, so hit
+        and cold answers are bit-identical by construction.  ``steps``:
+        how many tokens to greedy-decode beyond the prefix.
+
+        Returns one JSON-able dict: generated ``tokens``, final-step
+        ``indices``/``probs`` (top-k), the weights ``gen``,
+        ``cache_state`` (hit/cold/stale_gen/rebuilt/disabled),
+        ``session_tokens`` (prefix incorporated so far) and
+        ``steps_run`` (tokens actually stepped — the O(1)-vs-O(prefix)
+        cost, observable per response)."""
+        tokens, steps = self._decode_prep(tokens, steps)
+        stepper = self._stepper
         weights = self._weights_snapshot()
         params, state, gen, fingerprint = weights
         cache = self.session_cache
@@ -632,6 +732,298 @@ class InferenceEngine:
             "session_tokens": int(all_tokens.shape[0]),
             "steps_run": n_new + len(generated),
         }
+
+    # ----------------------------------------- continuous batched decode
+    def decode_batch(
+        self,
+        requests: Sequence[Dict[str, Any]] = (),
+        *,
+        admit=None,
+        on_result=None,
+    ) -> List[Any]:
+        """Continuous token-level batched decode (ISSUE 17): K live
+        sessions advance one token per dispatch through ONE batched
+        step executable, with admission and retirement at step
+        boundaries — PR 9's continuous batcher at token granularity.
+
+        ``requests``: dicts with ``tokens`` (full prefix), optional
+        ``session`` / ``steps`` / ``top_k`` / ``deadline`` (absolute
+        ``perf_counter`` time) / ``tag`` (opaque, handed back through
+        ``on_result``).  ``admit(free_slots)``: polled at every step
+        boundary for late arrivals (return an iterable of request
+        dicts; ``None``/empty when nothing is waiting).  ``on_result
+        (tag, value)``: called the moment a row retires — ``value`` is
+        the :meth:`generate`-shaped payload, or an exception
+        (``ValueError`` for bad requests, ``DeadlineExceeded`` for
+        per-token deadline sheds).  Returns the values in request-
+        intake order for direct callers.
+
+        Semantics, per row, are exactly :meth:`generate`: cache take at
+        admission, cold prefix replay as batch rows, greedy decode,
+        cache put at retirement.  Rows are padded up to the smallest
+        width in :attr:`decode_buckets` (floor 4 — width 1 compiles to
+        ulp-different fusion on CPU; widths >= 4 are mutually bitwise
+        row-independent, so per-row answers never depend on batch
+        occupancy).  Fairness is structural: every live row advances
+        exactly one token per dispatch, so a hot Zipf session cannot
+        starve the rest.  A second row for a session already live in
+        the window is **coalesced**: deferred until the live row
+        retires (whose ``put`` publishes the carry the deferred row
+        then takes as a hit) — ``take`` POPS, so admitting both would
+        silently rebuild the later row from its prefix.  Padded slots
+        are never rows: they appear in no response's ``steps_run`` /
+        ``session_tokens`` and only in the occupancy gauges.  One
+        weights snapshot covers the whole window (a hot-swap lands at
+        the next window, same discipline as ``infer_tagged``)."""
+        from .batcher import DeadlineExceeded
+
+        if self._stepper is None:
+            raise ValueError(
+                "decode_batch: model has no recurrent layer — serve a "
+                "decoder net (e.g. char_rnn_deploy.prototxt)"
+            )
+        stepper = self._stepper
+        weights = self._weights_snapshot()
+        params, state, gen, fingerprint = weights
+        cache = self.session_cache
+        max_w = self.decode_buckets[-1]
+        pending = deque(requests)
+        ordered: List[Any] = []
+        live: List[_DecodeRow] = []
+        active: Dict[str, _DecodeRow] = {}
+        deferred: Dict[str, deque] = {}
+
+        def finish(slot, tag, value):
+            ordered[slot] = value
+            if on_result is not None:
+                on_result(tag, value)
+
+        def release(session):
+            """A session's live row left the window: admit the oldest
+            coalesce-deferred request for it, if any."""
+            active.pop(session, None)
+            q = deferred.get(session)
+            if q:
+                activate(q.popleft())
+                if not q:
+                    deferred.pop(session, None)
+
+        def retire(row: _DecodeRow) -> None:
+            out_host = np.asarray(row.out)
+            if row.generated and stepper.vocab is not None:
+                all_tokens = np.concatenate(
+                    [row.tokens, np.asarray(row.generated, np.int32)]
+                )
+            else:
+                all_tokens = row.tokens
+            if row.session is not None:
+                cache.put(
+                    fingerprint, row.session, gen, all_tokens,
+                    row.carry, out_host,
+                )
+            idx, probs = self.postprocess(out_host, row.top_k)
+            finish(row.slot, row.tag, {
+                "tokens": [int(t) for t in row.generated],
+                "indices": idx[0].tolist(),
+                "probs": probs[0].tolist(),
+                "gen": gen,
+                "cache_state": row.cache_state,
+                "session_tokens": int(all_tokens.shape[0]),
+                "steps_run": row.steps_run,
+            })
+            if self.metrics is not None:
+                self.metrics.record_decode_done(retired=1)
+            if row.session is not None:
+                release(row.session)
+
+        def activate(req: Dict[str, Any]) -> None:
+            """Build the row (cache take, carry init) and admit it —
+            or retire it on the spot when a hit already covers the
+            whole request (full prefix cached, steps=0)."""
+            session = req.get("session")
+            tokens, steps = req["_tokens"], req["_steps"]
+            carry = None
+            done = 0
+            out = None
+            cache_state = "cold" if session is None else None
+            if session is not None:
+                entry, cache_state = cache.take(
+                    fingerprint, session, gen, tokens
+                )
+                if entry is not None:
+                    carry, done, out = (
+                        entry.carry, entry.tokens.size, entry.last_out
+                    )
+            if carry is None:
+                carry = stepper.init_carry(1)
+            row = _DecodeRow(
+                tag=req.get("tag", req["_slot"]), slot=req["_slot"],
+                session=None if session is None else str(session),
+                tokens=tokens, steps=steps,
+                top_k=int(req.get("top_k", 5)),
+                deadline=req.get("deadline"),
+                carry=carry, out=out, pos=done, cache_state=cache_state,
+            )
+            if session is not None and cache.enabled:
+                active[row.session] = row
+            if row.finished():
+                retire(row)
+            else:
+                live.append(row)
+
+        def intake(req) -> None:
+            req = dict(req)
+            req["_slot"] = len(ordered)
+            ordered.append(None)
+            req.setdefault("tag", req["_slot"])
+            try:
+                req["_tokens"], req["_steps"] = self._decode_prep(
+                    req.get("tokens"), req.get("steps", 0)
+                )
+            except (ValueError, TypeError) as e:
+                finish(req["_slot"], req["tag"], e)
+                return
+            session = req.get("session")
+            if (
+                session is not None and cache.enabled
+                and str(session) in active
+            ):
+                # coalesce: the SAME session is already a live row and
+                # take POPS — defer until its put republishes the carry
+                cache.note_coalesced()
+                deferred.setdefault(str(session), deque()).append(req)
+                return
+            activate(req)
+
+        def shed(slot, tag, session, waited) -> None:
+            finish(slot, tag, DeadlineExceeded(
+                f"decode row expired mid-window "
+                f"(deadline passed {waited:.3f}s ago)"
+            ))
+            if self.metrics is not None:
+                self.metrics.record_decode_done(shed=1)
+            if session is not None:
+                release(session)
+
+        dispatches = 0
+        # the batched carry stays RESIDENT across dispatches: `order`
+        # names the rows whose carries live in ``carry_b`` (slot-
+        # aligned); a row's ``carry`` is None while resident.  Restack
+        # happens only when membership or width changes — steady-state
+        # steps feed the device tree straight back in, instead of
+        # paying an unstack + concatenate per token.
+        carry_b = None
+        order: List[_DecodeRow] = []
+        width = 0
+
+        def materialize(row: _DecodeRow) -> None:
+            """Pull a resident row's per-row carry out of the batched
+            tree (lazily: membership changes and retirements only)."""
+            if row.carry is None:
+                i = order.index(row)
+                row.carry = {
+                    k: tuple(a[i : i + 1] for a in tup)
+                    for k, tup in carry_b.items()
+                }
+
+        while True:
+            now = time.perf_counter()
+            # (a) per-token deadline shedding at the step boundary
+            expired = [
+                r for r in live
+                if r.deadline is not None and now > r.deadline
+            ]
+            for r in expired:
+                live.remove(r)
+                shed(r.slot, r.tag, r.session, now - r.deadline)
+            for sid in list(deferred):
+                q = deferred.get(sid) or ()
+                for req in [
+                    r for r in q
+                    if r.get("deadline") is not None
+                    and now > r["deadline"]
+                ]:
+                    q.remove(req)
+                    shed(req["_slot"], req["tag"], None,
+                         now - req["deadline"])
+                if sid in deferred and not deferred[sid]:
+                    deferred.pop(sid)
+            # (b) step-boundary admission: queued requests first, then
+            # the caller's admit hook (the batcher's queue drain)
+            while pending and len(live) < max_w:
+                intake(pending.popleft())
+            if admit is not None and len(live) < max_w:
+                for req in admit(max_w - len(live)) or ():
+                    intake(req)
+            if not live:
+                if pending:
+                    continue
+                break
+            # (c) one batched step: every live row advances ONE token
+            n = len(live)
+            w = next(b for b in self.decode_buckets if b >= n)
+            if carry_b is None or w != width or live != order:
+                # membership or width changed: restack once.  Resident
+                # rows are materialized from the old batched tree by
+                # their old slot; newcomers already carry their own.
+                for r in live:
+                    materialize(r)
+                parts = [r.carry for r in live]
+                if w > n:
+                    parts.append(stepper.init_carry(w - n))
+                carry_b = {
+                    k: tuple(
+                        jnp.concatenate([p[k][j] for p in parts])
+                        for j in range(len(parts[0][k]))
+                    )
+                    for k in parts[0]
+                }
+                width = w
+            tok_np = np.zeros(
+                (width,) + stepper.row_shape,
+                jnp.dtype(stepper.token_dtype).name,
+            )
+            for i, row in enumerate(live):
+                if row.pos < row.n_prefix:
+                    tok_np[i] = row.tokens[row.pos]
+                    row.pos += 1
+                else:
+                    nxt = int(np.argmax(np.asarray(row.out)[0]))
+                    row.generated.append(nxt)
+                    tok_np[i] = nxt
+                row.steps_run += 1
+            exe = self._step_executable(width, weights)
+            t0 = time.perf_counter()
+            with _trace.span("serve.decode_batch", cat="serve",
+                             width=width, rows=n, padded=width - n,
+                             gen=gen, dispatch=dispatches):
+                out_b, carry_b = exe(
+                    params, state, carry_b, jnp.asarray(tok_np)
+                )
+                jax.block_until_ready(out_b)  # the device fence
+            if self.metrics is not None:
+                self.metrics.record_decode_step(
+                    width, rows=n, padded_rows=width - n,
+                    device_s=time.perf_counter() - t0,
+                )
+            dispatches += 1
+            # (d) one host transfer for the whole window; rows go
+            # carry-resident (their state lives in ``carry_b`` until a
+            # membership change or their own retirement pulls it out)
+            out_host = np.asarray(out_b)
+            order = list(live)
+            for i, row in enumerate(live):
+                row.out = out_host[i : i + 1]
+                row.carry = None
+            # retire finished rows (their put may release a coalesce-
+            # deferred row into the window)
+            done_rows = [r for r in live if r.finished()]
+            for r in done_rows:
+                materialize(r)
+                live.remove(r)
+            for r in done_rows:
+                retire(r)
+        return ordered
 
     # ------------------------------------------------------------------
     def postprocess(self, out: np.ndarray, top_k: int = 5):

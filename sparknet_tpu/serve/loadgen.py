@@ -24,6 +24,27 @@ import numpy as np
 from .batcher import MicroBatcher
 from .metrics import ServeMetrics
 
+#: Decode-heavy open-loop preset (docs/SERVING.md "Batched decode"):
+#: interactive arrivals are multi-token session steps over a Zipf-hot
+#: population, so nearly every request is decode work and the batched
+#: step executable sees sustained multi-session occupancy.  Used by
+#: ``BENCH_MODEL=session_serving``'s batched arm and reusable by the
+#: autoscale spike scenarios.  The script follows
+#: :func:`sparknet_tpu.autoscale.traffic.parse_script` grammar: a warm
+#: flat lane, a 3x decode burst, a recovery lane.
+DECODE_HEAVY_SCRIPT = (
+    "flat:rate=12,dur=3;"
+    "spike:base=12,mult=3,warm=1,burst=2,cool=2"
+)
+
+#: Companion kwargs for :func:`run_open_loadgen` under
+#: ``DECODE_HEAVY_SCRIPT`` — small hot session population (Zipf 1.1),
+#: several greedy continuations per step, a thin batch-class lane so
+#: admission control still has something to shed first.
+DECODE_HEAVY_KNOBS = dict(
+    sessions=8, session_zipf=1.1, session_steps=4, batch_frac=0.1,
+)
+
 
 def run_loadgen(
     engine,
@@ -198,6 +219,7 @@ def run_http_loadgen(
     session_counts: dict = {}
     session_states: dict = {}
     session_migrated = [0]
+    session_tokens = [0]  # greedy continuations actually delivered
 
     def _session_step(i: int, rng, client) -> None:
         k = int(rng.choice(sessions, p=session_probs))
@@ -240,6 +262,7 @@ def run_http_loadgen(
                 session_hist[sid] = hist + [
                     int(t) for t in resp["tokens"]
                 ]
+                session_tokens[0] += len(resp["tokens"])
                 session_counts[sid] = session_counts.get(sid, 0) + 1
                 st = str(resp.get("cache_state", "?"))
                 session_states[st] = session_states.get(st, 0) + 1
@@ -367,6 +390,10 @@ def run_http_loadgen(
                         )
                     ),
                     "migrated": session_migrated[0],
+                    # aggregate decode throughput the bench's batched
+                    # arm compares across SPARKNET_DECODE_BATCH on/off
+                    "tokens_generated": session_tokens[0],
+                    "tokens_per_sec": round(session_tokens[0] / dt, 2),
                     "hottest": sorted(
                         session_counts.items(),
                         key=lambda kv: -kv[1],
@@ -444,6 +471,7 @@ def run_open_loadgen(
     sem = threading.Semaphore(max(1, int(max_inflight)))
     by_class: dict = {}   # class -> {"offered","ok","shed","failed","slo_ok"}
     lat_by_class: dict = {}           # class -> [latency seconds]
+    tok_by_class: dict = {}           # class -> tokens delivered on ok
     shed_reasons: dict = {}           # reason/status -> count
     errors: list = []
     failed_traces: list = []
@@ -461,9 +489,12 @@ def run_open_loadgen(
             "offered": 0, "ok": 0, "shed": 0, "failed": 0, "slo_ok": 0,
         })
 
-    def _finish(cls, i, tid, sched_t, status, err):
+    def _finish(cls, i, tid, sched_t, status, err, tokens=0):
         """Classify one outcome under the lock.  ``err`` is an error
-        string (failed), ``status`` the final HTTP status."""
+        string (failed), ``status`` the final HTTP status; ``tokens``
+        is the decode-token count an ok reply delivered (0 for
+        classify — the per-class tokens/sec ledger counts generated
+        continuations, not classified rows)."""
         dt = time.monotonic() - sched_t
         with lock:
             b = _bucket(cls)
@@ -480,6 +511,7 @@ def run_open_loadgen(
             else:
                 b["ok"] += 1
                 lat_by_class.setdefault(cls, []).append(dt)
+                tok_by_class[cls] = tok_by_class.get(cls, 0) + tokens
                 if dt * 1000.0 <= slo_ms:
                     b["slo_ok"] += 1
 
@@ -550,7 +582,8 @@ def run_open_loadgen(
                     f"{len(resp.get('tokens', ()))} tokens back, "
                     f"asked {session_steps}")
             return
-        _finish("batch", i, tid, sched_t, status, None)
+        _finish("batch", i, tid, sched_t, status, None,
+                tokens=session_steps if status == 200 else 0)
 
     def _session_step(i, k, client, trace, tid, sched_t) -> None:
         sid = f"s{k}"
@@ -589,7 +622,8 @@ def run_open_loadgen(
                         f"{len(resp.get('tokens', ()))} tokens back, "
                         f"asked {session_steps}")
                 return
-            _finish("interactive", i, tid, sched_t, status, None)
+            _finish("interactive", i, tid, sched_t, status, None,
+                    tokens=len(resp["tokens"]))
             with lock:
                 session_hist[sid] = hist + [
                     int(t) for t in resp["tokens"]
@@ -648,12 +682,18 @@ def run_open_loadgen(
     classes_out = {}
     for cls, b in sorted(by_class.items()):
         lats = lat_by_class.get(cls, [])
+        toks = tok_by_class.get(cls, 0)
         classes_out[cls] = {
             **b,
             "slo_ok_frac": round(b["slo_ok"] / b["offered"], 4)
             if b["offered"] else None,
             "p50_ms": _pct(lats, 0.50),
             "p99_ms": _pct(lats, 0.99),
+            # decode-token ledger: continuations delivered on ok
+            # replies (0 for classify traffic), over the run's wall —
+            # the per-class aggregate the batched-decode bench reads
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(wall_s, 1e-9), 2),
         }
     inter = classes_out.get("interactive", {})
     total_failed = sum(b["failed"] for b in by_class.values())
@@ -686,6 +726,11 @@ def run_open_loadgen(
                     "distinct": len(session_hist),
                     "states": dict(sorted(session_states.items())),
                     "migrated": session_migrated[0],
+                    "tokens_generated": sum(tok_by_class.values()),
+                    "tokens_per_sec": round(
+                        sum(tok_by_class.values())
+                        / max(wall_s, 1e-9), 2
+                    ),
                 },
                 "session_failed_requests": session_failed[0],
             }

@@ -61,6 +61,19 @@ class ServeMetrics:
         self._last_degraded_t: float = float("-inf")
         self._queue_depth = Gauge()
         self.request_latency = LatencyHistogram()
+        # batched decode (ISSUE 17): one dispatch = one batched step
+        # executable run; rows = live session rows stepped (== real
+        # tokens produced/replayed), padded_rows = masked filler slots.
+        # Occupancy (rows / compiled slots) is THE utilization gauge of
+        # the continuous token-level batcher.
+        self.decode_dispatches = 0
+        self.decode_rows = 0
+        self.decode_padded_rows = 0
+        self.decode_retired = 0
+        self.decode_shed = 0
+        self._window_decode_rows = 0
+        self.decode_device = LatencyHistogram()
+        self.decode_per_width: Dict[int, dict] = {}
         self.per_bucket: Dict[int, dict] = {}
         for b in buckets:
             self._bucket(int(b))
@@ -90,6 +103,37 @@ class ServeMetrics:
             e["rows"] += rows
             e["padded_rows"] += padded_rows
             e["device"].observe(device_s)
+
+    def record_decode_step(
+        self, width: int, rows: int, padded_rows: int, device_s: float
+    ) -> None:
+        """One batched decode dispatch: ``rows`` live session rows
+        advanced one token each through the compiled ``width``-wide
+        step (``padded_rows`` slots were masked filler)."""
+        with self._lock:
+            self.decode_dispatches += 1
+            self.decode_rows += rows
+            self.decode_padded_rows += padded_rows
+            self._window_decode_rows += rows
+            self.decode_device.observe(device_s)
+            w = self.decode_per_width.get(int(width))
+            if w is None:
+                w = self.decode_per_width[int(width)] = {
+                    "dispatches": 0, "rows": 0, "padded_rows": 0,
+                }
+            w["dispatches"] += 1
+            w["rows"] += rows
+            w["padded_rows"] += padded_rows
+
+    def record_decode_done(self, retired: int = 0, shed: int = 0) -> None:
+        """Row lifecycle exits: ``retired`` rows completed, ``shed``
+        rows hit their per-token deadline mid-window (a shed also
+        degrades health, same as a queue-level shed)."""
+        with self._lock:
+            self.decode_retired += retired
+            self.decode_shed += shed
+            if shed:
+                self._last_degraded_t = time.perf_counter()
 
     def record_request(
         self, latency_s: float, rows: int = 1, exemplar=None
@@ -142,6 +186,27 @@ class ServeMetrics:
         return "ok"
 
     # -------------------------------------------------------------- reads
+    def decode_summary(self) -> dict:
+        """The healthz-scrape view of batched decode: occupancy +
+        lifetime tokens/sec + lifecycle counters.  Deliberately NOT
+        ``snapshot()["decode"]`` — a health scrape must not roll the
+        windowed-rate accounting other readers depend on."""
+        with self._lock:
+            uptime = max(time.perf_counter() - self._t0, 1e-9)
+            return {
+                "dispatches": self.decode_dispatches,
+                "rows": self.decode_rows,
+                "padded_rows": self.decode_padded_rows,
+                "occupancy": round(
+                    self.decode_rows
+                    / max(self.decode_rows + self.decode_padded_rows, 1),
+                    4,
+                ),
+                "retired": self.decode_retired,
+                "shed": self.decode_shed,
+                "tokens_per_sec": round(self.decode_rows / uptime, 2),
+            }
+
     def snapshot(self) -> dict:
         """JSON-able state. Also rolls the requests/s window (StepTimer
         style): ``window_requests_per_sec`` covers the span since the
@@ -171,6 +236,31 @@ class ServeMetrics:
                 "queue_depth": self._queue_depth.value,
                 "queue_depth_max": self._queue_depth.max,
                 "request_latency": self.request_latency.snapshot(),
+                "decode": {
+                    "dispatches": self.decode_dispatches,
+                    "rows": self.decode_rows,
+                    "padded_rows": self.decode_padded_rows,
+                    # batch occupancy: real rows per compiled slot —
+                    # 1.0 means every dispatched lane carried a session
+                    "occupancy": round(
+                        self.decode_rows
+                        / max(self.decode_rows + self.decode_padded_rows, 1),
+                        4,
+                    ),
+                    "retired": self.decode_retired,
+                    "shed": self.decode_shed,
+                    # aggregate decode throughput: one live row stepped
+                    # = one token (replayed or generated)
+                    "tokens_per_sec": round(self.decode_rows / uptime, 2),
+                    "window_tokens_per_sec": round(
+                        self._window_decode_rows / window, 2
+                    ),
+                    "device_latency": self.decode_device.snapshot(),
+                    "per_width": {
+                        str(w): dict(e)
+                        for w, e in sorted(self.decode_per_width.items())
+                    },
+                },
                 "per_bucket": {
                     str(b): {
                         "batches": e["batches"],
@@ -190,6 +280,7 @@ class ServeMetrics:
             }
             self._window_t0 = now
             self._window_requests = 0
+            self._window_decode_rows = 0
             return out
 
     def json_line(self) -> str:

@@ -82,6 +82,11 @@ class Replica:
         self.weights_source: Optional[str] = None
         self.compile_cache: Optional[dict] = None
         self.session_cache: Optional[dict] = None
+        # batched-decode scrape (ISSUE 17): occupancy / tokens-per-sec
+        # / width ladder off the replica's healthz — the holder
+        # accounting for batched rows rides the same block the session
+        # panel aggregates
+        self.decode: Optional[dict] = None
         self.pid: Optional[int] = None
         self.forwarded = 0
         self.latency = LatencyHistogram()
@@ -102,6 +107,7 @@ class Replica:
             "weights_source": self.weights_source,
             "compile_cache": self.compile_cache,
             "session_cache": self.session_cache,
+            "decode": self.decode,
             "pid": self.pid,
             "forwarded": self.forwarded,
             "latency": self.latency.snapshot(),
@@ -776,6 +782,7 @@ class Router:
                 rep.weights_source = doc.get("weights_source")
                 rep.compile_cache = doc.get("compile_cache")
                 rep.session_cache = doc.get("session_cache")
+                rep.decode = doc.get("decode")
                 rep.pid = doc.get("pid")
             else:
                 rep.consecutive_fails += 1

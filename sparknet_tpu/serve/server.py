@@ -68,7 +68,12 @@ from typing import Optional
 import numpy as np
 
 from ..telemetry import reqtrace
-from .batcher import Backpressure, DeadlineExceeded, MicroBatcher
+from .batcher import (
+    Backpressure,
+    DeadlineExceeded,
+    MicroBatcher,
+    decode_batching_enabled,
+)
 from .metrics import ServeMetrics
 
 
@@ -205,6 +210,19 @@ class InferenceServer:
                         # router reads resident sessions + hit counters
                         # off the same scrape that drives affinity
                         payload["session_cache"] = sessions.snapshot()
+                    decode_buckets = getattr(
+                        outer.engine, "decode_buckets", ()
+                    )
+                    if decode_buckets:
+                        # batched decode (ISSUE 17): the A/B flag state
+                        # + width ladder + occupancy/tokens-per-sec off
+                        # the live metrics — the router aggregates this
+                        # block the same way it does session_cache
+                        payload["decode"] = {
+                            "batching": decode_batching_enabled(),
+                            "buckets": list(decode_buckets),
+                            **outer.metrics.decode_summary(),
+                        }
                     self._reply(200, payload)
                 elif self.path == "/dash":
                     # the zero-dependency live dashboard
@@ -479,13 +497,30 @@ class InferenceServer:
                                 headers=trace_headers(400))
                     return
                 try:
-                    fut = outer.batcher.submit_call(
-                        lambda: outer.engine.generate(
-                            tokens, session=session, steps=steps,
-                            top_k=top_k,
-                        ),
-                        ctx=rhop.ctx if rhop is not None else None,
-                    )
+                    if (
+                        decode_batching_enabled()
+                        and getattr(outer.engine, "decode_buckets", ())
+                    ):
+                        # the batched token loop (ISSUE 17): this
+                        # request becomes one row of a continuous
+                        # decode window — K sessions per dispatch
+                        fut = outer.batcher.submit_decode(
+                            {
+                                "tokens": tokens, "session": session,
+                                "steps": steps, "top_k": top_k,
+                            },
+                            ctx=rhop.ctx if rhop is not None else None,
+                        )
+                    else:
+                        # A/B baseline (SPARKNET_DECODE_BATCH=0): the
+                        # PR 13 serial path, one generate per turn
+                        fut = outer.batcher.submit_call(
+                            lambda: outer.engine.generate(
+                                tokens, session=session, steps=steps,
+                                top_k=top_k,
+                            ),
+                            ctx=rhop.ctx if rhop is not None else None,
+                        )
                 except Backpressure as e:
                     outer.metrics.record_error()
                     self._reply(

@@ -303,6 +303,7 @@ class SessionCache:
         self.stale_gen = 0
         self.rebuilt = 0
         self.puts = 0
+        self.coalesced = 0
         REGISTRY.register_source("session_cache", self)
 
     # ------------------------------------------------------------------
@@ -367,6 +368,15 @@ class SessionCache:
         with self._lock:
             self._entries.pop((fingerprint, str(session)), None)
 
+    def note_coalesced(self) -> None:
+        """A batched-decode window held back a second row for a session
+        already live in the batch (``take`` POPS — admitting both would
+        make the later row rebuild from prefix).  The deferred row waits
+        for the live row's ``put`` and then takes a hit; this counter
+        makes the coalesce observable on /metrics and /dash."""
+        with self._lock:
+            self.coalesced += 1
+
     # ------------------------------------------------------------------
     def resident(self) -> Tuple[int, int]:
         with self._lock:
@@ -390,6 +400,7 @@ class SessionCache:
                 "stale_gen": self.stale_gen,
                 "rebuilt": self.rebuilt,
                 "puts": self.puts,
+                "coalesced": self.coalesced,
                 "hit_rate": round(self.hits / total, 4) if total else None,
             }
 
@@ -408,6 +419,9 @@ class _DisabledSessionCache:
         pass
 
     def drop(self, fingerprint, session):
+        pass
+
+    def note_coalesced(self):
         pass
 
     def resident(self):

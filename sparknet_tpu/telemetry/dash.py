@@ -248,12 +248,14 @@ def _router_section(router: dict) -> str:
     )
 
 
-def _session_section(session: dict) -> str:
+def _session_section(session: dict, decode: Optional[dict] = None) -> str:
     """Session-cache panel (ISSUE 13): hit/miss/evict/stale-gen tiles
     from the ``session_cache`` registry source (a replica's own cache)
     or the router-side aggregate over replica health scrapes.  A
     hot-swap shows up as a ``stale gen`` pulse — every invalidation is
-    a counted rebuild, never a silently-wrong answer."""
+    a counted rebuild, never a silently-wrong answer.  With batched
+    decode live (ISSUE 17), the panel grows batch-occupancy and
+    aggregate tokens/sec tiles from the ``decode`` metrics block."""
     total = sum(
         session.get(k, 0)
         for k in ("hits", "misses", "stale_gen", "rebuilt")
@@ -275,6 +277,22 @@ def _session_section(session: dict) -> str:
         _tile("prefix rebuilt", str(session.get("rebuilt", 0)),
               "history mismatch"),
     ]
+    if decode:
+        occ = decode.get("occupancy")
+        tps = (
+            decode.get("window_tokens_per_sec")
+            or decode.get("tokens_per_sec") or 0
+        )
+        tiles += [
+            _tile("batch occupancy",
+                  f"{occ:.0%}" if occ is not None else "—",
+                  f"{decode.get('dispatches', 0)} dispatches"),
+            _tile("decode tokens/s", f"{tps:g}",
+                  f"{decode.get('rows', 0)} tokens, "
+                  f"{decode.get('shed', 0)} shed"),
+            _tile("coalesced", str(session.get("coalesced", 0)),
+                  "same-session rows deferred"),
+        ]
     return (
         '<section><h2>Sessions <span class="muted">'
         "(per-session decode-state cache; docs/SERVING.md)</span></h2>"
@@ -295,9 +313,36 @@ def _session_aggregate(router: Optional[dict]) -> Optional[dict]:
             continue
         seen = True
         for k in ("entries", "resident_bytes", "max_bytes", "hits",
-                  "misses", "evictions", "stale_gen", "rebuilt"):
+                  "misses", "evictions", "stale_gen", "rebuilt",
+                  "coalesced"):
             agg[k] = agg.get(k, 0) + int(sc.get(k) or 0)
     return dict(agg, enabled=True) if seen else None
+
+
+def _decode_aggregate(router: Optional[dict]) -> Optional[dict]:
+    """Sum the replicas' batched-decode health blocks (ISSUE 17) into
+    one router-level view; occupancy is recomputed from the summed
+    row counts, tokens/sec adds across replicas."""
+    if router is None:
+        return None
+    agg: Dict[str, float] = {}
+    seen = False
+    for r in router.get("replicas", []):
+        d = r.get("decode")
+        if not d or not d.get("dispatches"):
+            continue
+        seen = True
+        for k in ("dispatches", "rows", "padded_rows", "retired",
+                  "shed", "tokens_per_sec"):
+            agg[k] = agg.get(k, 0) + (d.get(k) or 0)
+    if not seen:
+        return None
+    agg["occupancy"] = round(
+        agg.get("rows", 0)
+        / max(agg.get("rows", 0) + agg.get("padded_rows", 0), 1),
+        4,
+    )
+    return agg
 
 
 def _reqtrace_section(records: List[dict]) -> str:
@@ -449,6 +494,11 @@ def render_html(
     session = registry_snapshot.get("session_cache")
     if not (session and session.get("enabled")):
         session = _session_aggregate(router)
+    # batched-decode tiles: this process's own metrics on a replica
+    # (only once dispatches happened), the scrape aggregate on a router
+    decode = serve.get("decode")
+    if not (decode and decode.get("dispatches")):
+        decode = _decode_aggregate(router)
     active_anoms = anomalies or []
     health = serve.get("health", "ok")
     degraded = health != "ok" or any(
@@ -466,7 +516,7 @@ def render_html(
   <span class="muted">rendered {time.strftime('%H:%M:%S')}, refreshes every {refresh_s}s</span>
 </header>
 {_router_section(router) if router is not None else ''}
-{_session_section(session) if session else ''}
+{_session_section(session, decode) if session else ''}
 {_reqtrace_section(reqtrace) if reqtrace else ''}
 <section><h2>Serving</h2><div class="tiles">{''.join(tiles)}</div></section>
 <section><h2>Latency SLO <span class="muted">(p99 budget {budget:g} ms)</span></h2>
