@@ -70,6 +70,14 @@ Env knobs:
                              load with a mid-session holder SIGKILL
                              (zero failed requests + counted
                              migrations is the bar)
+  BENCH_MODEL=closed_loop    closed-loop deploy lifecycle (ISSUE 18):
+                             scripts/closed_loop_smoke.py e2e —
+                             traffic tee -> incremental trainer ->
+                             eval gate -> gated roll -> chaos-
+                             regressed roll -> auto-rollback;
+                             rollback_ms headline (lower-better),
+                             deploy_failed_requests and
+                             bad_gen_served_after_rollback zero bars
   BENCH_BATCH, BENCH_ITERS   override batch size / timed iterations
   BENCH_PROFILE=<dir>        wrap the timed loop in jax.profiler.trace
   BENCH_INPUT_PIPELINE=1     ImageNet archs: feed fresh host batches
@@ -2268,6 +2276,60 @@ def bench_bert(platform: str) -> dict:
     }
 
 
+def bench_closed_loop(platform: str) -> dict:
+    """Closed-loop deploy A/B (``BENCH_MODEL=closed_loop``, ISSUE 18).
+
+    Runs ``scripts/closed_loop_smoke.py`` — a 2-replica tier with the
+    full model lifecycle on (traffic tee -> incremental trainer ->
+    eval gate -> gated roll -> chaos-regressed roll -> watch-fired
+    auto-rollback) — and reports its measured numbers:
+
+    - ``rollback_ms``: tier-wide rollback latency (resident-previous
+      pointer exchange on every replica; lower-is-better diffed)
+    - ``deploy_failed_requests``: failed requests across both rolls
+      AND the rollback (ZERO is the bar)
+    - ``bad_gen_served_after_rollback``: post-rollback answers that
+      disagree with the restored generation (ZERO is the bar)
+
+    The lifecycle is CPU-meaningful end to end: every number is a
+    latency or an absolute correctness count, not throughput."""
+    import subprocess
+    import tempfile
+
+    metrics_out = os.path.join(
+        tempfile.mkdtemp(prefix="bench_closed_loop_"), "metrics.json"
+    )
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_HERE, "scripts", "closed_loop_smoke.py"),
+         "--metrics-out", metrics_out],
+        capture_output=True, text=True, timeout=580,
+    )
+    if proc.returncode != 0 or not os.path.exists(metrics_out):
+        raise RuntimeError(
+            f"closed_loop smoke failed (exit {proc.returncode}): "
+            f"{(proc.stdout or '')[-2000:]}\n{(proc.stderr or '')[-2000:]}"
+        )
+    with open(metrics_out) as fh:
+        m = json.load(fh)
+    return {
+        "metric": "closed_loop_rollback_ms",
+        "value": m["rollback_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "platform": platform,
+        "rollback_ms": m["rollback_ms"],
+        "deploy_failed_requests": m["deploy_failed_requests"],
+        "bad_gen_served_after_rollback": m["bad_gen_served_after_rollback"],
+        "rolls": m.get("rolls"),
+        "rollbacks": m.get("rollbacks"),
+        "requests": m.get("requests"),
+        "teed_samples": m.get("teed_samples"),
+        "fired_reason": m.get("fired_reason"),
+        "served_generations": m.get("served_generations"),
+    }
+
+
 def main() -> None:
     # an explicit JAX_PLATFORMS=cpu must not be overridden by the axon
     # register hook's "axon,cpu" config (and must skip the 90 s probe)
@@ -2306,6 +2368,8 @@ def main() -> None:
         runner = bench_session_serving
     elif mode == "fusion":
         runner = bench_fusion
+    elif mode == "closed_loop":
+        runner = bench_closed_loop
     elif mode in IMAGENET_ARCHS:
         runner = functools.partial(bench_imagenet, arch=mode)
     else:
@@ -2315,7 +2379,7 @@ def main() -> None:
             f"BENCH_MODEL={mode!r}: want "
             f"bert|input_pipeline|data_plane|comm|sharding|reshard|"
             f"serving_tier|quant_serving|session_serving|fusion|"
-            f"{'|'.join(IMAGENET_ARCHS)}"
+            f"closed_loop|{'|'.join(IMAGENET_ARCHS)}"
         )
     if profile_dir:
         with jax.profiler.trace(profile_dir):
@@ -2368,6 +2432,8 @@ if __name__ == "__main__":
                         if mode == "session_serving"
                         else "fusion_step_ms_fused"
                         if mode == "fusion"
+                        else "closed_loop_rollback_ms"
+                        if mode == "closed_loop"
                         else f"{mode}_train_images_per_sec_per_chip"
                     ),
                     "value": 0.0,

@@ -333,6 +333,24 @@ def diff(old: Dict[str, Any], new: Dict[str, Any], args) -> int:
     if b is not None:
         a = find_key(old, "session_migrations")
         add("session_migrations", a, b, "", False, "informational")
+    # closed-loop deploy records (BENCH_MODEL=closed_loop, ISSUE 18):
+    # zero failed requests across both rolls AND the rollback, zero
+    # post-rollback answers from the bad generation — both ABSOLUTE —
+    # and the tier-wide rollback latency diffs lower-is-better (the
+    # resident-previous pointer exchange must stay cheap)
+    for key, what in (
+        ("deploy_failed_requests", "failed request"),
+        ("bad_gen_served_after_rollback", "bad-generation answer"),
+    ):
+        b = new.get(key)
+        if b is not None:
+            add(key, old.get(key), b, "", bool(b),
+                f"ZERO {what}s is the bar" if b else "ok")
+    a, b = old.get("rollback_ms"), new.get("rollback_ms")
+    if a and b:
+        rise = (b - a) / a
+        add("rollback_ms", a, b, "", rise > args.rollback_pct / 100.0,
+            f"{rise:+.1%}")
     # served-generation coverage (hot-swap observability): count of
     # distinct generations answered during the run — informational
     gens_old = (old.get("tier") or {}).get("served_generations")
@@ -415,6 +433,12 @@ def main(argv=None) -> int:
                     help="session-cache cached-vs-cold per-request "
                          "latency floor, x (session_serving records; "
                          "default 5)")
+    ap.add_argument("--rollback-pct", type=float, default=100.0,
+                    help="max tolerated tier-rollback latency rise, "
+                         "percent (closed_loop records; default 100 — "
+                         "tens of ms on this box, so scheduling noise "
+                         "needs generous headroom; the real guarantees "
+                         "are the zero bars)")
     ap.add_argument("--informational", action="store_true",
                     help="print the table but always exit 0 (the "
                          "check.sh mode)")

@@ -412,6 +412,23 @@ else
   exit 1
 fi
 
+# ---- closed-loop deploy smoke (ISSUE 18): a 2-replica tier with
+# --deploy-dir closes the lifecycle — served traffic tees into a packed
+# log, the supervised incremental trainer emits candidates, the eval
+# gate verifies + agreement-checks each before the roll, the first roll
+# survives its watch window and becomes baseline, the second roll is
+# chaos-regressed post-gate (deploy.regressed_weights) and the watch's
+# front-door probe replay fires an auto-rollback to the resident
+# previous generation — zero failed requests end to end, the bad
+# digest machine-checkably ineligible (ledger + re-roll -> 409), zero
+# bad-generation answers after rollback.
+if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/closed_loop_smoke.py; then
+  echo "check.sh: closed-loop smoke OK (tee -> train -> gate -> roll -> regression -> rollback, 0 failed)"
+else
+  echo "check.sh: closed-loop SMOKE FAILED"
+  exit 1
+fi
+
 # ---- quant smoke (ISSUE 12): an int8 1-replica tier hot-swaps a
 # manifest-verified snapshot (scales re-captured at swap time), the
 # quant tag rides /healthz and /classify next to gen, f32-vs-int8

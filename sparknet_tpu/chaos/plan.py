@@ -95,6 +95,20 @@ FAULT_POINTS: Dict[str, str] = {
         "forcing the verified-resume fallback chain; coords: index "
         "(restart count); params: frac (default 0.5)"
     ),
+    "deploy.poison_snapshot": (
+        "deploy gate's candidate solverstate is corrupted (truncated) "
+        "BEFORE evaluation — the gate must quarantine it with a fail "
+        "verdict, never serve it; coords: index (per-process gate "
+        "evaluation count), iter (parsed from the candidate path); "
+        "params: frac (default 0.5)"
+    ),
+    "deploy.regressed_weights": (
+        "engine hot-swap scales one weight leaf AFTER the gate saw "
+        "clean bytes (silent post-gate regression) — the deploy watch "
+        "window must detect the agreement drop and auto-roll-back; "
+        "coords: index (per-process swap_from_file count); params: "
+        "frac (scale factor, default 8.0)"
+    ),
 }
 
 # which coordinate serves as the schedule index, in priority order

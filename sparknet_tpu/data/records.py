@@ -426,6 +426,62 @@ def pack_arrays(
     )
 
 
+def shard_stats(path: str) -> Dict[str, Any]:
+    """Reconstruct a shard's ``ShardWriter.finish()`` dict by reading
+    the file back (record count, content CRC over record CRCs, region
+    sum).  Raises :class:`ShardError` on a torn shard.  Used by the
+    deploy tee's crash recovery to adopt an intact orphan shard —
+    finished on disk but not yet manifested — without rewriting it."""
+    r = PackedShardReader(path)
+    try:
+        content_crc = 0
+        for i in range(r.n):
+            off = int(r.offsets[i])
+            _, crc = _REC.unpack_from(r._buf, off)
+            content_crc = zlib.crc32(struct.pack("<I", crc), content_crc)
+        return {
+            "file": os.path.basename(path),
+            "records": r.n,
+            "bytes": os.path.getsize(path),
+            "content_crc": content_crc,
+            "region_sum": r.region_sum(),
+        }
+    finally:
+        r.close()
+
+
+def write_manifest(
+    out_dir: str,
+    shards: Sequence[Dict[str, Any]],
+    fields: Dict[str, Any],
+    *,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Atomically (tmp + rename) publish ``MANIFEST.json`` over a set
+    of finished shard dicts.  Readers opening the split mid-rewrite see
+    either the old or the new manifest, never a torn one — the contract
+    that lets the deploy tee grow a *live* split under concurrent
+    trainer reads."""
+    manifest: Dict[str, Any] = {
+        "format": "sparknet-packed",
+        "version": _VERSION,
+        "record_count": int(sum(s["records"] for s in shards)),
+        "fields": fields,
+        "shards": list(shards),
+        "fingerprint": _fingerprint(shards),
+    }
+    if meta:
+        manifest["meta"] = meta
+    # pid-unique tmp name: concurrent publishers (one tee writer per
+    # replica process over a shared log) must not clobber each other's
+    # tmp between write and rename
+    tmp = os.path.join(out_dir, f"{MANIFEST_NAME}.{os.getpid()}.tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))
+    return manifest
+
+
 def _fingerprint(shards: Sequence[Dict[str, Any]]) -> str:
     """Content-derived dataset identity: format version + every shard's
     (name, record count, content CRC).  Two packs of the same records

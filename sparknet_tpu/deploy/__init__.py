@@ -1,0 +1,33 @@
+"""Gated model lifecycle: tee → train → gate → roll → watch → rollback.
+
+The closed loop that connects the serving tier back to training
+(docs/SERVING.md "Model lifecycle", ROADMAP item 2):
+
+- :mod:`.tee` — replicas append served requests into a live packed
+  shard split (PR 8 format) without ever backpressuring the request
+  path.
+- :mod:`.trainer` — an incremental supervised train job that consumes
+  the growing log, resuming exactly at the log head via O(1)
+  ``skip(n)``.
+- :mod:`.gate` — every candidate snapshot passes manifest verification
+  plus a held-out top-1 agreement bar vs the serving generation before
+  it may roll; rejections are quarantined with machine-readable
+  verdicts, rolled-back digests become ineligible.
+- :mod:`.rollback` — the armed post-roll watch window: SLO burn or
+  agreement regression rolls the tier back to the resident previous
+  generation (O(1) pointer exchange, no recompile).
+- :mod:`.controller` — the router-side loop that ties them together.
+"""
+
+from .tee import TeeWriter, recover_log  # noqa: F401
+from .gate import (  # noqa: F401
+    DeployGateError,
+    check_eligible,
+    evaluate,
+    gate_required,
+    mark_ineligible,
+    read_verdict,
+    snapshot_digest,
+)
+from .rollback import RollbackWatch  # noqa: F401
+from .controller import DeployController  # noqa: F401

@@ -1,0 +1,386 @@
+"""Deploy controller — the loop that closes the loop.
+
+One self-threaded controller per router ties the pipeline together:
+
+    tee log  ->  trainer (supervised child)  ->  candidate snapshots
+        ->  eval gate  ->  rolling reload  ->  armed watch window
+        ->  (burn / regression)  ->  tier-wide rollback + ledger
+
+It deliberately does NOT ride the router's health tick: gate
+evaluation builds two inference engines (seconds of compile on a cold
+cache) and must never stall the 0.5 s replica probes.  The controller
+owns its own thread, its own trainer :class:`ChildPool` (crash =
+respawn = exact log-head resume, ``deploy/trainer.py``), and reports
+into the router via ``router.deploy = controller`` — the snapshot
+rides ``/healthz`` and the dash timeline.
+
+Rollback is the cheap direction by construction: every replica keeps
+the previous generation's weight trees resident (weights are
+executable *arguments*, ``engine.rollback()`` is a pointer exchange),
+so the tier-wide roll back is O(replicas) HTTP round-trips with zero
+recompiles — ``rollback_ms`` is measured and benched.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..telemetry.registry import REGISTRY
+from . import gate
+from .rollback import RollbackWatch
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class DeployController:
+    """Own-threaded gate/watch/rollback loop over a deploy directory.
+
+    ``deploy_dir`` layout (created on start):
+
+    - ``log/``         — the replicas' tee target (packed shards)
+    - ``candidates/``  — trainer output; verdicts, probes and the
+      ineligibility ledger land next to the snapshots
+    """
+
+    def __init__(
+        self,
+        router,
+        *,
+        deploy_dir: str,
+        model: str,
+        train_net: str,
+        boot_weights: Optional[str] = None,
+        interval_s: float = 1.0,
+        window_s: Optional[float] = None,
+        regress_pct: Optional[float] = None,
+        probe_n: Optional[int] = None,
+        min_new_records: Optional[int] = None,
+        batch_size: int = 8,
+        base_lr: float = 0.05,
+        run_trainer: bool = True,
+    ):
+        self.router = router
+        self.deploy_dir = deploy_dir
+        self.log_dir = os.path.join(deploy_dir, "log")
+        self.candidate_dir = os.path.join(deploy_dir, "candidates")
+        self.model = model
+        self.train_net = train_net
+        self.boot_weights = boot_weights
+        self.interval_s = float(interval_s)
+        self.window_s = (
+            _env_float("SPARKNET_DEPLOY_WATCH_S", 30.0)
+            if window_s is None else float(window_s)
+        )
+        self.regress_pct = (
+            _env_float("SPARKNET_DEPLOY_REGRESS_PCT", 2.0)
+            if regress_pct is None else float(regress_pct)
+        )
+        self.probe_n = int(
+            _env_float("SPARKNET_DEPLOY_PROBE_N", 32)
+            if probe_n is None else probe_n
+        )
+        self.min_new_records = int(
+            _env_float("SPARKNET_DEPLOY_MIN_NEW", self.probe_n)
+            if min_new_records is None else min_new_records
+        )
+        self.batch_size = int(batch_size)
+        self.base_lr = float(base_lr)
+        os.makedirs(self.log_dir, exist_ok=True)
+        os.makedirs(self.candidate_dir, exist_ok=True)
+
+        # the serving baseline the gate compares candidates against;
+        # promoted only after a rolled generation SURVIVES its watch
+        self.baseline = boot_weights
+        self.last_gated_iter = -1
+        self.watch = RollbackWatch(
+            window_s=self.window_s, regress_pct=self.regress_pct
+        )
+        self.rolls = 0
+        self.rollbacks = 0
+        self.last_rollback_ms: Optional[float] = None
+        self.events: collections.deque = collections.deque(maxlen=64)
+        self._pool = None
+        if run_trainer:
+            from ..supervise.pool import ChildPool
+
+            self._pool = ChildPool(
+                self._trainer_argv, 1, name="deploy-trainer"
+            )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- trainer pool
+
+    def _trainer_argv(self, index: int, spawn: int) -> List[str]:
+        argv = [
+            sys.executable, "-m", "sparknet_tpu.deploy.trainer",
+            "--log-dir", self.log_dir,
+            "--net", self.train_net,
+            "--out-dir", self.candidate_dir,
+            "--prefix", "inc",
+            "--batch-size", str(self.batch_size),
+            "--base-lr", str(self.base_lr),
+        ]
+        if self.boot_weights:
+            argv += ["--init-weights", self.boot_weights]
+        return argv
+
+    # ------------------------------------------------------- probe supply
+
+    def _log_probe(self) -> Optional[np.ndarray]:
+        """Held-out probe = real teed traffic: the newest manifested
+        shard's rows (the samples the trainer just consumed are exactly
+        the distribution the candidate must agree on)."""
+        from ..data import records as rec
+
+        if not os.path.exists(
+            os.path.join(self.log_dir, rec.MANIFEST_NAME)
+        ):
+            return None
+        try:
+            ds = rec.PackedDataset(self.log_dir)
+            if (
+                ds.num_records < self.min_new_records
+                or ds.num_partitions == 0
+            ):
+                return None
+            part = ds.collect_partition(ds.num_partitions - 1)
+        except (rec.ShardError, OSError, ValueError, KeyError):
+            return None
+        rows = part.get("data")
+        if rows is None or not len(rows):
+            return None
+        return np.asarray(rows[: self.probe_n], dtype=np.float32)
+
+    # ------------------------------------------------------- probe replay
+
+    def _probe_fn(self, probe: np.ndarray) -> Optional[np.ndarray]:
+        """Replay the gate probe through the FRONT DOOR (the router),
+        so the watch sees what clients see."""
+        from ..serve.server import Client
+
+        try:
+            status, doc = Client(
+                self.router.host, self.router.port, timeout=30.0,
+                retries=1,
+            ).classify(probe, top_k=1)
+        except Exception:
+            return None
+        if status != 200:
+            return None
+        idx = doc.get("indices")
+        if not idx:
+            return None
+        return np.asarray([row[0] for row in idx], dtype=np.int64)
+
+    # ------------------------------------------------------- one tick
+
+    def tick(self) -> Optional[str]:
+        """One controller round, callable without the thread (tests):
+        supervise the trainer, watch an armed window, else gate+roll
+        the next candidate.  Returns the rollback reason when this
+        tick rolled the tier back, else None."""
+        if self._pool is not None:
+            for ev in self._pool.tick():
+                if ev.get("event") == "exit":
+                    self._event("trainer_exit", detail=str(
+                        ev.get("code", ev.get("child"))
+                    ))
+        if self.watch.armed:
+            return self._watch_tick()
+        return self._gate_tick()
+
+    def _watch_tick(self) -> Optional[str]:
+        from ..telemetry import anomaly
+
+        reason = self.watch.tick(
+            probe_fn=self._probe_fn,
+            burn_active=bool(anomaly.active("slo_burn")),
+        )
+        if reason is not None:
+            self._roll_back(reason)
+            return reason
+        if not self.watch.armed and self.watch.fired_reason is None:
+            # survived the window: promote to baseline
+            self.baseline = self.watch.source or self.baseline
+            self._event("watch_pass", detail=os.path.basename(
+                self.watch.source
+            ))
+        return None
+
+    def _gate_tick(self) -> None:
+        from ..serve import hotswap
+
+        cands = hotswap.snapshot_candidates(self.candidate_dir)
+        fresh = [c for c in cands if c[0] > self.last_gated_iter]
+        if not fresh:
+            return None
+        it, path = fresh[0]  # newest first: skip superseded candidates
+        probe = self._log_probe()
+        if probe is None:
+            return None
+        baseline = self.baseline or self.boot_weights
+        if not baseline:
+            return None
+        ctx = self._span_ctx()
+        hop = self._span(ctx, "deploy.gate")
+        verdict = gate.evaluate(
+            path,
+            model=self.model,
+            baseline_weights=baseline,
+            probe=probe,
+        )
+        self._span_finish(hop, ctx)
+        self.last_gated_iter = it
+        if verdict.get("verdict") != "pass":
+            self._event(
+                "gate_reject",
+                detail=f"iter {it}: {verdict.get('reason')}",
+            )
+            return None
+        self._roll(it, path, verdict)
+        return None
+
+    # ------------------------------------------------------- roll paths
+
+    def _roll(self, it: int, path: str, verdict: Dict[str, Any]) -> None:
+        ctx = self._span_ctx()
+        hop = self._span(ctx, "deploy.roll")
+        code, doc = self.router.roll(path)
+        self._span_finish(hop, ctx)
+        if code != 200:
+            self._event(
+                "roll_failed",
+                detail=f"iter {it}: HTTP {code}: {doc.get('error')}",
+            )
+            return
+        self.rolls += 1
+        REGISTRY.counter("deploy_events", action="roll").inc()
+        self._event("roll", detail=f"iter {it} "
+                                   f"({len(doc.get('rolled', []))} replicas)")
+        previous = self.baseline or self.boot_weights or ""
+        saved = gate.load_probe(path)
+        self.watch.arm(
+            source=path,
+            previous=previous,
+            digest=verdict.get("digest") or "",
+            probe=None if saved is None else saved["probe"],
+            expected_top1=(
+                None if saved is None else saved["expected_top1"]
+            ),
+        )
+
+    def _roll_back(self, reason: str) -> None:
+        kind = reason.split(":", 1)[0]
+        ctx = self._span_ctx()
+        hop = self._span(ctx, "deploy.rollback")
+        t0 = time.monotonic()
+        code, doc = self.router.roll_back(reason)
+        self.last_rollback_ms = (time.monotonic() - t0) * 1e3
+        self._span_finish(hop, ctx)
+        self.rollbacks += 1
+        REGISTRY.counter(
+            "deploy_events", action="rollback", reason=kind
+        ).inc()
+        # no-flap: the rolled-back fingerprint can never redeploy
+        source = self.watch.source
+        if source and os.path.exists(source):
+            gate.mark_ineligible(source, reason=kind)
+        elif self.watch.digest:
+            gate.mark_ineligible(
+                self.watch.digest, reason=kind,
+                source=source or os.path.join(self.candidate_dir, "x"),
+            )
+        self._event(
+            "rollback",
+            detail=f"{kind}: HTTP {code}, "
+                   f"{len(doc.get('rolled_back', []))} replicas, "
+                   f"{self.last_rollback_ms:.0f} ms",
+        )
+        # the baseline stays the PREVIOUS generation (never promoted)
+
+    # ------------------------------------------------------- tracing
+
+    def _span_ctx(self):
+        from ..telemetry import reqtrace
+
+        return reqtrace.mint()  # None when tracing is off
+
+    def _span(self, ctx, name: str):
+        from ..telemetry import reqtrace
+
+        return reqtrace.hop(ctx, name) if ctx is not None else None
+
+    def _span_finish(self, hop, ctx) -> None:
+        if hop is None:
+            return
+        from ..telemetry import reqtrace
+
+        wall = hop.finish()
+        reqtrace.finish(ctx, wall)
+
+    # ------------------------------------------------------- lifecycle
+
+    def _event(self, action: str, detail: str = "") -> None:
+        self.events.append(
+            {"t": time.time(), "action": action, "detail": detail}
+        )
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # a gate/probe crash must not kill the deploy loop
+                continue
+
+    def start(self) -> "DeployController":
+        if self._pool is not None:
+            self._pool.start()
+        self._thread = threading.Thread(
+            target=self._loop, name="deploy-controller", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval_s + 10.0)
+        if self._pool is not None:
+            try:
+                self._pool.stop()
+            except Exception:
+                pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        doc = {
+            "deploy_dir": self.deploy_dir,
+            "baseline": (
+                os.path.basename(self.baseline) if self.baseline else None
+            ),
+            "last_gated_iter": self.last_gated_iter,
+            "rolls": self.rolls,
+            "rollbacks": self.rollbacks,
+            "last_rollback_ms": (
+                round(self.last_rollback_ms, 2)
+                if self.last_rollback_ms is not None else None
+            ),
+            "watch": self.watch.snapshot(),
+            "events": list(self.events),
+        }
+        if self._pool is not None:
+            doc["trainer"] = self._pool.snapshot()
+        return doc

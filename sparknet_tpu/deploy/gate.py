@@ -1,0 +1,340 @@
+"""Eval gate: no candidate snapshot serves traffic unverified.
+
+Every candidate produced by the incremental trainer passes through
+:func:`evaluate` before the router may roll it:
+
+1. ``deploy.poison_snapshot`` chaos fires here — the candidate file is
+   corrupted BEFORE the gate looks, proving the gate path (not luck)
+   keeps poison out of the tier.
+2. Manifest verification: the snapshot loads through
+   ``solver.snapshot.load_state`` (embedded-manifest + digest checks);
+   a torn/poisoned file fails here.
+3. Held-out top-1 agreement vs the serving generation on a probe
+   batch, same discipline as quant's 0.5% gate (plus an optional
+   absolute accuracy bar when labels exist).
+
+The verdict is a machine-readable JSON record next to the snapshot
+(``<snap>.verdict.json``, written atomically) carrying the file's
+content digest, so a post-verdict byte swap is detectable.  Failures
+are quarantined (renamed ``.quarantined`` — out of the watcher's
+glob).  Rolled-back digests land in a per-directory ledger
+(``DEPLOY_LEDGER.json``): an ineligible fingerprint cannot redeploy
+without a NEW snapshot — no flapping.
+
+Enforcement (the SnapshotWatcher fix): with ``SPARKNET_DEPLOY_GATE=1``
+the engine's ``swap_from_file`` refuses ungated/failed/ineligible
+snapshots with :class:`DeployGateError`, which the replica server and
+the router both surface as HTTP 409.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import chaos
+from ..telemetry.registry import REGISTRY
+
+VERDICT_SUFFIX = ".verdict.json"
+PROBE_SUFFIX = ".probe.npz"
+LEDGER_NAME = "DEPLOY_LEDGER.json"
+QUARANTINE_SUFFIX = ".quarantined"
+
+_ITER_RE = re.compile(r"_iter_(\d+)\.solverstate\.(npz|orbax)$")
+_eval_seq = itertools.count()
+
+
+class DeployGateError(RuntimeError):
+    """Snapshot is not cleared to serve: no verdict, failed verdict,
+    digest mismatch, or rolled-back (ineligible) fingerprint.  Maps to
+    HTTP 409 at the replica /reload and the router."""
+
+
+def gate_required() -> bool:
+    """Is gate enforcement on (``SPARKNET_DEPLOY_GATE``)?  Read at
+    call time so tests can flip it per-case."""
+    return os.environ.get("SPARKNET_DEPLOY_GATE", "").lower() in (
+        "1", "on", "require", "required", "true"
+    )
+
+
+def default_disagree_pct() -> float:
+    return float(os.environ.get("SPARKNET_DEPLOY_DISAGREE_PCT", "0.5"))
+
+
+def snapshot_digest(path: str) -> str:
+    """Content digest of the snapshot file bytes (sha256, 32 hex) —
+    the identity the verdict and the ineligibility ledger key on."""
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()[:32]
+
+
+def _iter_of(path: str) -> int:
+    m = _ITER_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def verdict_path(snapshot: str) -> str:
+    return snapshot + VERDICT_SUFFIX
+
+
+def read_verdict(snapshot: str) -> Optional[Dict[str, Any]]:
+    p = verdict_path(snapshot)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_json(path: str, doc: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------- ineligibility ledger
+
+def _ledger_path(dirname: str) -> str:
+    return os.path.join(dirname or ".", LEDGER_NAME)
+
+
+def load_ledger(dirname: str) -> Dict[str, Any]:
+    p = _ledger_path(dirname)
+    if not os.path.exists(p):
+        return {"ineligible": {}}
+    try:
+        with open(p) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {"ineligible": {}}
+    doc.setdefault("ineligible", {})
+    return doc
+
+
+def mark_ineligible(
+    snapshot_or_digest: str, *, reason: str, source: str = ""
+) -> str:
+    """Record a digest as never-redeployable (rollback aftermath).
+    Accepts a snapshot path (digest computed, ledger lands next to it)
+    or a bare digest with ``source`` giving the directory."""
+    if os.path.exists(snapshot_or_digest):
+        digest = snapshot_digest(snapshot_or_digest)
+        dirname = os.path.dirname(snapshot_or_digest)
+        source = source or snapshot_or_digest
+    else:
+        digest = snapshot_or_digest
+        dirname = os.path.dirname(source)
+    ledger = load_ledger(dirname)
+    ledger["ineligible"][digest] = {
+        "reason": reason,
+        "source": os.path.basename(source) if source else "",
+        "t": time.time(),
+    }
+    _write_json(_ledger_path(dirname), ledger)
+    REGISTRY.counter("deploy_events", action="mark_ineligible").inc()
+    return digest
+
+
+def is_ineligible(snapshot: str, digest: Optional[str] = None) -> bool:
+    ledger = load_ledger(os.path.dirname(snapshot))
+    if not ledger["ineligible"]:
+        return False
+    digest = digest or snapshot_digest(snapshot)
+    return digest in ledger["ineligible"]
+
+
+# ------------------------------------------------- eligibility check
+
+def check_eligible(snapshot: str) -> Tuple[bool, str]:
+    """Is ``snapshot`` cleared to serve?  (pass verdict, digest still
+    matching the verdicted bytes, not in the ineligibility ledger.)
+    Pure read — safe from the engine's swap path and the router."""
+    v = read_verdict(snapshot)
+    if v is None:
+        return False, "ungated (no verdict record)"
+    if v.get("verdict") != "pass":
+        return False, f"gate verdict: {v.get('reason', 'fail')}"
+    try:
+        digest = snapshot_digest(snapshot)
+    except OSError as e:
+        return False, f"unreadable: {e}"
+    if digest != v.get("digest"):
+        return False, "digest mismatch (bytes changed after gating)"
+    if is_ineligible(snapshot, digest):
+        return False, "ineligible (rolled back; needs a new snapshot)"
+    return True, "ok"
+
+
+def require_eligible(snapshot: str) -> None:
+    """Raise :class:`DeployGateError` unless the snapshot is gated
+    eligible — the hook ``swap_from_file`` threads the verdict
+    through when ``SPARKNET_DEPLOY_GATE`` is on."""
+    ok, reason = check_eligible(snapshot)
+    if not ok:
+        raise DeployGateError(f"{os.path.basename(snapshot)}: {reason}")
+
+
+# ------------------------------------------------- the gate itself
+
+def _chaos_poison(candidate: str) -> Optional[str]:
+    """``deploy.poison_snapshot``: truncate the candidate in place
+    before the gate looks (same tear shape as snapshot.partial_write)."""
+    plan = chaos.get_plan()
+    rule = plan.match(
+        "deploy.poison_snapshot",
+        index=next(_eval_seq),
+        iter=max(_iter_of(candidate), 0),
+    ) if plan else None
+    if not rule:
+        return None
+    frac = float(rule.params.get("frac", 0.5))
+    size = os.path.getsize(candidate)
+    with open(candidate, "rb+") as fh:
+        fh.truncate(max(1, int(size * frac)))
+    return f"chaos poisoned to {frac:.2f} of {size} bytes"
+
+
+def quarantine(candidate: str, reason: str) -> str:
+    """Move a rejected candidate out of the watcher's glob; the
+    verdict record stays at the original name for the audit trail."""
+    dest = candidate + QUARANTINE_SUFFIX
+    if os.path.exists(candidate):
+        os.replace(candidate, dest)
+    REGISTRY.counter("deploy_events", action="quarantine").inc()
+    return dest
+
+
+def evaluate(
+    candidate: str,
+    *,
+    model: str,
+    baseline_weights: str,
+    probe: np.ndarray,
+    labels: Optional[np.ndarray] = None,
+    max_disagree_pct: Optional[float] = None,
+    min_accuracy: Optional[float] = None,
+    do_quarantine: bool = True,
+) -> Dict[str, Any]:
+    """Gate one candidate snapshot; returns the verdict dict (also
+    written to ``<candidate>.verdict.json``).  On pass, the probe
+    inputs and the candidate's own top-1 answers are saved to
+    ``<candidate>.probe.npz`` — the post-roll watch replays them
+    through the front door and any disagreement with these gate-time
+    answers is a live regression."""
+    from ..serve.engine import InferenceEngine
+    from ..solver.snapshot import SnapshotError
+
+    bar = default_disagree_pct() if max_disagree_pct is None else float(
+        max_disagree_pct
+    )
+    poisoned = _chaos_poison(candidate)
+    verdict: Dict[str, Any] = {
+        "candidate": os.path.basename(candidate),
+        "baseline": os.path.basename(baseline_weights),
+        "iter": _iter_of(candidate),
+        "n_probe": int(len(probe)),
+        "max_disagree_pct": bar,
+        "t": time.time(),
+    }
+    try:
+        verdict["digest"] = snapshot_digest(candidate)
+    except OSError as e:
+        verdict["digest"] = None
+        return _reject(candidate, verdict, f"unreadable: {e}", do_quarantine)
+    if is_ineligible(candidate, verdict["digest"]):
+        return _reject(
+            candidate, verdict,
+            "ineligible (previously rolled back)", do_quarantine,
+        )
+    try:
+        cand = InferenceEngine.from_files(
+            model, candidate, buckets=(max(1, len(probe)),)
+        )
+    except (SnapshotError, ValueError, KeyError, OSError) as e:
+        reason = f"manifest verify failed: {e}"
+        if poisoned:
+            reason += f" ({poisoned})"
+        return _reject(candidate, verdict, reason, do_quarantine)
+    base = InferenceEngine.from_files(
+        model, baseline_weights, buckets=(max(1, len(probe)),)
+    )
+    cand_top1 = np.argmax(np.asarray(cand.infer(probe)), axis=-1)
+    base_top1 = np.argmax(np.asarray(base.infer(probe)), axis=-1)
+    disagree_pct = 100.0 * float(np.mean(cand_top1 != base_top1))
+    verdict["disagree_pct"] = round(disagree_pct, 4)
+    if labels is not None:
+        labels = np.asarray(labels).reshape(-1)
+        acc = float(np.mean(cand_top1 == labels))
+        base_acc = float(np.mean(base_top1 == labels))
+        verdict["accuracy"] = round(acc, 4)
+        verdict["baseline_accuracy"] = round(base_acc, 4)
+        if min_accuracy is not None and acc < float(min_accuracy):
+            return _reject(
+                candidate, verdict,
+                f"accuracy {acc:.4f} < bar {float(min_accuracy):.4f}",
+                do_quarantine,
+            )
+        # with labels in hand, a candidate may disagree with the old
+        # generation as long as it is NOT less accurate than it
+        if disagree_pct > bar and acc < base_acc:
+            return _reject(
+                candidate, verdict,
+                f"disagree {disagree_pct:.2f}% > {bar:.2f}% and accuracy "
+                f"regressed {base_acc:.4f} -> {acc:.4f}",
+                do_quarantine,
+            )
+    elif disagree_pct > bar:
+        return _reject(
+            candidate, verdict,
+            f"top-1 disagreement {disagree_pct:.2f}% > bar {bar:.2f}%",
+            do_quarantine,
+        )
+    verdict["verdict"] = "pass"
+    verdict["reason"] = "ok"
+    np.savez(
+        candidate + PROBE_SUFFIX + ".tmp.npz",
+        probe=np.asarray(probe),
+        expected_top1=cand_top1.astype(np.int64),
+    )
+    os.replace(
+        candidate + PROBE_SUFFIX + ".tmp.npz", candidate + PROBE_SUFFIX
+    )
+    _write_json(verdict_path(candidate), verdict)
+    REGISTRY.counter("deploy_events", action="gate_pass").inc()
+    return verdict
+
+
+def _reject(
+    candidate: str, verdict: Dict[str, Any], reason: str, do_quarantine: bool
+) -> Dict[str, Any]:
+    verdict["verdict"] = "fail"
+    verdict["reason"] = reason
+    _write_json(verdict_path(candidate), verdict)
+    REGISTRY.counter("deploy_events", action="gate_reject").inc()
+    if do_quarantine:
+        verdict["quarantined_to"] = quarantine(candidate, reason)
+    return verdict
+
+
+def load_probe(snapshot: str) -> Optional[Dict[str, np.ndarray]]:
+    """The gate-time probe + expected answers for a passed snapshot
+    (what the rollback watch replays)."""
+    p = snapshot + PROBE_SUFFIX
+    if not os.path.exists(p):
+        return None
+    with np.load(p) as z:
+        return {"probe": z["probe"], "expected_top1": z["expected_top1"]}

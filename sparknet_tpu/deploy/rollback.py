@@ -1,0 +1,159 @@
+"""Post-roll watch window → auto-rollback decision.
+
+After the router rolls a gated generation, a :class:`RollbackWatch`
+arms for ``window_s`` seconds.  Each controller tick feeds it two
+signals:
+
+- **SLO burn** — PR 11's ``slo_burn`` advisory (fast+slow window
+  burn-rate detector) active on the tier;
+- **agreement regression** — the gate-time probe replayed through the
+  front door: the new generation answered these exact inputs at gate
+  time, so any live top-1 drift past ``regress_pct`` means the
+  *served* weights are not the weights the gate cleared (e.g. the
+  ``deploy.regressed_weights`` chaos point, a bad quant fold, memory
+  corruption).
+
+Either signal inside the window returns a rollback reason — once.
+The watch disarms itself *before* reporting, so a double burn-fire
+rolls back exactly once (pinned by test).  Surviving the window
+disarms with ``deploy_events{action=watch_pass}`` — the generation is
+accepted and becomes the next baseline.
+
+The class is deliberately transport-free (probe delivery is a
+callback, time is injectable): the unit tests drive it without a
+tier, and the controller wires it to real HTTP + anomaly state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..telemetry.registry import REGISTRY
+
+
+class RollbackWatch:
+    """Armed window after a roll; decides *whether* to roll back.
+    The controller owns *how* (the O(1) resident-previous pointer
+    exchange on every replica)."""
+
+    def __init__(
+        self,
+        *,
+        window_s: float = 30.0,
+        regress_pct: float = 2.0,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.window_s = float(window_s)
+        self.regress_pct = float(regress_pct)
+        self._now = now
+        self._armed = False
+        self._deadline = 0.0
+        self._probe: Optional[np.ndarray] = None
+        self._expected: Optional[np.ndarray] = None
+        self.source = ""
+        self.previous = ""
+        self.digest = ""
+        self.probe_errors = 0
+        self.last_disagree_pct: Optional[float] = None
+        self.fired_reason: Optional[str] = None
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def arm(
+        self,
+        *,
+        source: str,
+        previous: str,
+        digest: str = "",
+        probe: Optional[np.ndarray] = None,
+        expected_top1: Optional[np.ndarray] = None,
+    ) -> None:
+        """Start watching a freshly rolled generation."""
+        self._armed = True
+        self._deadline = self._now() + self.window_s
+        self.source = source
+        self.previous = previous
+        self.digest = digest
+        self._probe = None if probe is None else np.asarray(probe)
+        self._expected = (
+            None if expected_top1 is None
+            else np.asarray(expected_top1).reshape(-1)
+        )
+        self.probe_errors = 0
+        self.last_disagree_pct = None
+        self.fired_reason = None
+        REGISTRY.counter("deploy_events", action="watch_arm").inc()
+
+    def disarm(self) -> None:
+        self._armed = False
+
+    def tick(
+        self,
+        *,
+        probe_fn: Optional[Callable[[np.ndarray], Optional[np.ndarray]]],
+        burn_active: bool,
+    ) -> Optional[str]:
+        """One watch tick.  Returns a rollback reason exactly once per
+        armed window, or None.  ``probe_fn`` maps the probe inputs to
+        live top-1 answers through the front door (None on transient
+        failure — counted, never treated as a regression)."""
+        if not self._armed:
+            return None
+        if self._now() >= self._deadline:
+            # survived the window: the generation is accepted
+            self._armed = False
+            REGISTRY.counter("deploy_events", action="watch_pass").inc()
+            return None
+        if burn_active:
+            return self._fire("slo_burn")
+        if (
+            probe_fn is not None
+            and self._probe is not None
+            and self._expected is not None
+        ):
+            try:
+                live = probe_fn(self._probe)
+            except Exception:
+                live = None
+            if live is None:
+                self.probe_errors += 1
+                return None
+            live = np.asarray(live).reshape(-1)
+            if len(live) != len(self._expected):
+                self.probe_errors += 1
+                return None
+            pct = 100.0 * float(np.mean(live != self._expected))
+            self.last_disagree_pct = pct
+            if pct > self.regress_pct:
+                return self._fire(
+                    f"agreement_regressed:{pct:.2f}pct"
+                )
+        return None
+
+    def _fire(self, reason: str) -> str:
+        # disarm BEFORE reporting: a second burn-fire in the same
+        # window must not request a second rollback
+        self._armed = False
+        self.fired_reason = reason
+        return reason
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "armed": self._armed,
+            "source": self.source,
+            "previous": self.previous,
+            "window_s": self.window_s,
+            "remaining_s": (
+                round(max(0.0, self._deadline - self._now()), 2)
+                if self._armed else 0.0
+            ),
+            "regress_pct": self.regress_pct,
+            "last_disagree_pct": self.last_disagree_pct,
+            "probe_errors": self.probe_errors,
+            "fired_reason": self.fired_reason,
+        }

@@ -1,0 +1,318 @@
+"""Traffic tee: served requests → a live packed shard split.
+
+Replicas call :meth:`TeeWriter.offer` from the request path.  The
+contract is absolute: **offer never blocks and never raises** — when
+the bounded buffer is full the sample is dropped and counted
+(``deploy_tee{event=drop}``), exactly like reqtrace's ≤2%-overhead
+discipline.  A background thread drains the buffer into
+``ShardWriter`` shards (PR 8 format: crc'd records, index footer,
+fingerprinted manifest) and republishes ``MANIFEST.json`` atomically
+after each finished shard, so concurrent readers (the incremental
+trainer's :class:`~..data.records.PackedDataset`) only ever see
+complete shards.  A crash mid-shard leaves a torn tail ``.snpk`` that
+is NOT in the manifest; :func:`recover_log` detects it on the next
+open (reader-side, the ``data.torn_shard`` discipline) and quarantines
+it, while an intact orphan — finished but not yet manifested — is
+adopted without a rewrite via :func:`~..data.records.shard_stats`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data import records as rec
+from ..telemetry.registry import REGISTRY
+
+QUARANTINE_SUFFIX = ".quarantined"
+# in-progress shards live under this suffix (full name
+# ``shard-<pid>-<k>-00000.snpk.writing``) and are renamed to ``.snpk``
+# only when finished — so every ``.snpk`` a reader can see is either
+# manifested or a COMPLETE orphan, and the reader never races a live
+# writer's tail
+WRITING_SUFFIX = ".writing"
+
+
+def _writer_pid(name: str) -> Optional[int]:
+    try:
+        return int(name.split("-")[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def recover_log(out_dir: str) -> Dict[str, Any]:
+    """Reader-side recovery of a tee log directory: quarantine torn
+    orphan shards (rename aside with a counter), adopt intact orphans
+    into the manifest.  Idempotent; returns a summary dict.  Both the
+    tee writer (on restart) and the trainer (on every open) run this
+    first, so a torn tail can never be trained on."""
+    os.makedirs(out_dir, exist_ok=True)
+    # a crashed writer's in-progress shard: quarantine only when its
+    # pid is gone — a LIVE writer's tail is its own business
+    for name in sorted(os.listdir(out_dir)):
+        if not name.endswith(rec.SHARD_SUFFIX + WRITING_SUFFIX):
+            continue
+        pid = _writer_pid(name)
+        alive = False
+        if pid is not None:
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                alive = True  # exists, just not ours to signal
+        if not alive:
+            path = os.path.join(out_dir, name)
+            os.replace(path, path + QUARANTINE_SUFFIX)
+            REGISTRY.counter("deploy_tee", event="quarantine_torn").inc()
+    manifest_path = os.path.join(out_dir, rec.MANIFEST_NAME)
+    shards: List[Dict[str, Any]] = []
+    fields: Dict[str, Any] = {}
+    meta: Optional[Dict[str, Any]] = None
+    if os.path.exists(manifest_path):
+        import json
+
+        with open(manifest_path) as fh:
+            m = json.load(fh)
+        shards = list(m.get("shards") or [])
+        fields = m.get("fields") or {}
+        meta = m.get("meta")
+    known = {s["file"] for s in shards}
+    adopted, quarantined = [], []
+    for name in sorted(os.listdir(out_dir)):
+        if not name.endswith(rec.SHARD_SUFFIX) or name in known:
+            continue
+        path = os.path.join(out_dir, name)
+        try:
+            stats = rec.shard_stats(path)
+        except (rec.ShardError, OSError, ValueError):
+            os.replace(path, path + QUARANTINE_SUFFIX)
+            REGISTRY.counter("deploy_tee", event="quarantine_torn").inc()
+            quarantined.append(name)
+            continue
+        shards.append(stats)
+        adopted.append(name)
+        REGISTRY.counter("deploy_tee", event="adopt_orphan").inc()
+    if adopted:
+        if not fields and shards:
+            fields = _fields_from_shard(
+                os.path.join(out_dir, shards[0]["file"])
+            )
+        rec.write_manifest(out_dir, shards, fields, meta=meta)
+    return {
+        "shards": len(shards),
+        "records": int(sum(s["records"] for s in shards)),
+        "adopted": adopted,
+        "quarantined": quarantined,
+    }
+
+
+def _fields_from_shard(path: str) -> Dict[str, Any]:
+    r = rec.PackedShardReader(path)
+    try:
+        sample = r.record(0) if r.n else None
+    finally:
+        r.close()
+    if not sample:
+        return {}
+    return {
+        k: {"dtype": np.asarray(v).dtype.str,
+            "shape": list(np.asarray(v).shape)}
+        for k, v in sample.items()
+    }
+
+
+class TeeWriter:
+    """Bounded, non-blocking append of served samples into a growing
+    packed split at ``out_dir``.
+
+    ``offer({"data": row, "label": y})`` is the only request-path
+    call: a deque append plus two counter bumps, O(1), lock-free under
+    the GIL.  Encoding, CRCs, fsync and manifest rewrites all happen
+    on the drain thread."""
+
+    _instances = itertools.count()
+
+    def __init__(
+        self,
+        out_dir: str,
+        *,
+        capacity: int = 4096,
+        shard_records: int = 256,
+        interval_s: float = 0.25,
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        self.out_dir = out_dir
+        # shard names are writer-scoped (pid + in-process instance):
+        # N replica processes — or N writers in one test process —
+        # tee into ONE log dir without ever racing on a filename
+        self._writer_id = f"{os.getpid()}-{next(TeeWriter._instances)}"
+        self.capacity = int(capacity)
+        self.shard_records = int(shard_records)
+        self._interval_s = float(interval_s)
+        self._meta = dict(meta or {})
+        self._buf: deque = deque()
+        self.offered = 0
+        self.dropped = 0
+        self.written = 0
+        # request-path counters are pre-resolved once — offer() must
+        # not pay label-dict hashing per call
+        self._c_offer = REGISTRY.counter("deploy_tee", event="offer")
+        self._c_drop = REGISTRY.counter("deploy_tee", event="drop")
+        self._c_shard = REGISTRY.counter("deploy_tee", event="shard")
+        summary = recover_log(out_dir)
+        self._io_lock = threading.Lock()
+        self._shards: List[Dict[str, Any]] = self._manifest_shards()
+        self._fields: Dict[str, Any] = self._manifest_fields()
+        self._seq = self._next_seq()
+        self._writer: Optional[rec.ShardWriter] = None
+        self._writer_n = 0
+        self.recovered = summary
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="deploy-tee", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------- request path
+
+    def offer(self, sample: Dict[str, np.ndarray]) -> bool:
+        """Append one sample; drop (counted) instead of ever blocking."""
+        if self._stop.is_set() or len(self._buf) >= self.capacity:
+            self.dropped += 1
+            self._c_drop.inc()
+            return False
+        self._buf.append(sample)
+        self.offered += 1
+        self._c_offer.inc()
+        return True
+
+    # ------------------------------------------------- drain thread
+
+    def _manifest_shards(self) -> List[Dict[str, Any]]:
+        import json
+
+        p = os.path.join(self.out_dir, rec.MANIFEST_NAME)
+        if not os.path.exists(p):
+            return []
+        with open(p) as fh:
+            return list(json.load(fh).get("shards") or [])
+
+    def _manifest_fields(self) -> Dict[str, Any]:
+        import json
+
+        p = os.path.join(self.out_dir, rec.MANIFEST_NAME)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as fh:
+            return json.load(fh).get("fields") or {}
+
+    def _next_seq(self) -> int:
+        # seq resumes past this writer's own shards (pid reuse corner)
+        prefix = f"shard-{self._writer_id}-"
+        seq = 0
+        for name in os.listdir(self.out_dir):
+            if name.startswith(prefix) and name.endswith(rec.SHARD_SUFFIX):
+                try:
+                    seq = max(
+                        seq,
+                        int(name[len(prefix):-len(rec.SHARD_SUFFIX)]) + 1,
+                    )
+                except ValueError:
+                    pass
+        return seq
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self._drain()
+        self._drain()
+        with self._io_lock:
+            self._seal_shard()
+
+    def _drain(self) -> None:
+        with self._io_lock:
+            while self._buf:
+                sample = self._buf.popleft()
+                if self._writer is None:
+                    path = os.path.join(
+                        self.out_dir,
+                        f"shard-{self._writer_id}-{self._seq:05d}"
+                        f"{rec.SHARD_SUFFIX}{WRITING_SUFFIX}",
+                    )
+                    self._writer = rec.ShardWriter(path)
+                    self._writer_n = 0
+                    self._seq += 1
+                try:
+                    self._writer.add(
+                        {k: np.asarray(v) for k, v in sample.items()}
+                    )
+                except Exception:
+                    REGISTRY.counter("deploy_tee", event="encode_error").inc()
+                    continue
+                if not self._fields:
+                    self._fields = {
+                        k: {"dtype": np.asarray(v).dtype.str,
+                            "shape": list(np.asarray(v).shape)}
+                        for k, v in sample.items()
+                    }
+                self._writer_n += 1
+                self.written += 1
+                if self._writer_n >= self.shard_records:
+                    self._seal_shard()
+
+    def _seal_shard(self) -> None:
+        if self._writer is None or self._writer_n == 0:
+            return
+        stats = self._writer.finish()
+        # publish the finished bytes under the reader-visible name
+        final = self._writer.path[: -len(WRITING_SUFFIX)]
+        os.replace(self._writer.path, final)
+        stats["file"] = os.path.basename(final)
+        self._shards.append(stats)
+        self._writer = None
+        self._writer_n = 0
+        self._c_shard.inc()
+        # merge-on-publish: start from the on-disk manifest (other tee
+        # writers may have published since we last read) and APPEND our
+        # unmanifested shards — the list stays append-only, which the
+        # trainer's bit-exact resume depends on (record k never moves).
+        # A lost update in the remaining race window only *omits* a
+        # finished shard; the reader-side recover_log re-adopts it.
+        merged = self._manifest_shards()
+        known = {s["file"] for s in merged}
+        merged.extend(s for s in self._shards if s["file"] not in known)
+        self._shards = merged
+        rec.write_manifest(
+            self.out_dir, self._shards, self._fields,
+            meta=self._meta or None,
+        )
+
+    # ------------------------------------------------- control
+
+    def flush(self) -> None:
+        """Drain the buffer and publish everything buffered so far as a
+        finished, manifested shard (tests + controlled shutdown)."""
+        self._drain()
+        with self._io_lock:
+            self._seal_shard()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "dir": self.out_dir,
+            "offered": self.offered,
+            "dropped": self.dropped,
+            "written": self.written,
+            "buffered": len(self._buf),
+            "shards": len(self._shards),
+            "capacity": self.capacity,
+        }

@@ -248,6 +248,12 @@ class InferenceEngine:
         self.generation = 0
         self.weights_source: Optional[str] = None
         self.warmup_s: Optional[float] = None
+        # previous installed generation, kept resident for O(1)
+        # recompile-free rollback (deploy/rollback.py): post-install
+        # trees + fingerprint, one level deep
+        self._resident_prev: Optional[Dict[str, Any]] = None
+        self.rolled_back_from: Optional[str] = None
+        self._swap_file_count = 0
         self._install(params, state)
 
     # ------------------------------------------------------------------
@@ -303,9 +309,53 @@ class InferenceEngine:
         arch change re-keys the cache (and pays compiles — warm them
         via :meth:`warmup` before routing traffic)."""
         with self._swap_lock:
+            # retain the outgoing generation resident: rollback is
+            # then a pure pointer exchange — no file I/O, no
+            # re-quantize, no recompile (weights are arguments)
+            self._resident_prev = {
+                "params": self.params,
+                "state": self.state,
+                "fingerprint": self.fingerprint,
+                "weights_source": self.weights_source,
+                "ref_params": getattr(self, "_ref_params", None),
+                "ref_state": getattr(self, "_ref_state", None),
+                "params_sh": getattr(self, "_params_sh", None),
+                "state_sh": getattr(self, "_state_sh", None),
+            }
             self._install(params, state)
             self.generation += 1
             self.weights_source = source
+            gen = self.generation
+        if self.metrics is not None:
+            self.metrics.record_hot_swap(gen)
+        return gen
+
+    def rollback(self) -> int:
+        """Swap back to the resident previous generation — O(1) and
+        recompile-free (the retained trees were installed once
+        already; the compile cache keys on their fingerprint).  One
+        level deep and consumed on use: a second rollback without an
+        intervening swap raises, which is what makes a double
+        burn-fire roll back exactly once."""
+        with self._swap_lock:
+            prev = self._resident_prev
+            if prev is None:
+                raise ValueError(
+                    "rollback: no previous generation resident"
+                )
+            self._resident_prev = None
+            self.rolled_back_from = self.weights_source
+            self.params = prev["params"]
+            self.state = prev["state"]
+            self.fingerprint = prev["fingerprint"]
+            self.weights_source = prev["weights_source"]
+            if prev["ref_params"] is not None:
+                self._ref_params = prev["ref_params"]
+                self._ref_state = prev["ref_state"]
+            if prev["params_sh"] is not None:
+                self._params_sh = prev["params_sh"]
+                self._state_sh = prev["state_sh"]
+            self.generation += 1
             gen = self.generation
         if self.metrics is not None:
             self.metrics.record_hot_swap(gen)
@@ -317,7 +367,19 @@ class InferenceEngine:
         raises before the swap, so the old generation keeps serving.
         Quantized engines merge onto the retained f32 reference tree
         (never onto int8/bf16 leaves) and re-capture scales in
-        ``_install``."""
+        ``_install``.
+
+        With ``SPARKNET_DEPLOY_GATE`` on, solverstate snapshots must
+        additionally carry a *pass* gate verdict matching the file's
+        current digest and not be in the ineligibility ledger
+        (deploy/gate.py) — otherwise :class:`DeployGateError` raises
+        here and the HTTP layer answers 409.  Manifest verification
+        alone is no longer a license to serve."""
+        if ".solverstate." in os.path.basename(weights):
+            from ..deploy import gate as _gate
+
+            if _gate.gate_required():
+                _gate.require_eligible(weights)
         if self.quant != "f32":
             base_params, base_state = self._ref_params, self._ref_state
         else:
@@ -325,6 +387,31 @@ class InferenceEngine:
         params, state = load_weights_any(
             self.net, base_params, base_state, weights
         )
+        # deploy.regressed_weights chaos: scale one leaf AFTER the
+        # gate saw clean bytes — the silent post-gate regression the
+        # rollback watch exists to catch
+        from .. import chaos as _chaos
+
+        plan = _chaos.get_plan()
+        rule = plan.match(
+            "deploy.regressed_weights", index=self._swap_file_count
+        ) if plan else None
+        self._swap_file_count += 1
+        if rule:
+            # scale HALF the units of the first weight matrix: a
+            # uniform scale would be argmax-invariant (ReLU is
+            # positively homogeneous), but a lopsided one reliably
+            # moves top-1 answers — a detectable live regression
+            frac = float(rule.params.get("frac", 8.0))
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            for i, leaf in enumerate(leaves):
+                arr = np.array(leaf)
+                if arr.ndim < 2:
+                    continue
+                arr[..., : max(1, arr.shape[-1] // 2)] *= frac
+                leaves[i] = arr
+                params = jax.tree_util.tree_unflatten(treedef, leaves)
+                break
         return self.swap(params, state, source=weights)
 
     def _weights_snapshot(self):

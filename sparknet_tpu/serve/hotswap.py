@@ -58,13 +58,23 @@ def snapshot_candidates(target: str) -> List[Tuple[int, str]]:
 
 
 def newest_verified(
-    target: str, on_torn: Optional[Callable] = None
+    target: str,
+    on_torn: Optional[Callable] = None,
+    *,
+    eligible: Optional[Callable[[str], bool]] = None,
 ) -> Optional[Tuple[int, str]]:
     """Newest manifest-intact solverstate under ``target`` (prefix or
     directory), or None.  The hot-swap safety gate: a torn or
     wrong-era file is skipped (and reported via ``on_torn``), never
-    handed to a swap."""
+    handed to a swap.  ``eligible`` adds a second filter — the deploy
+    gate's verdict check (deploy/gate.py): with gating on, an
+    un-verdicted or rolled-back snapshot is skipped here, so the
+    watcher falls through to the newest snapshot that is BOTH
+    manifest-intact and gate-eligible instead of parking on an
+    unservable one."""
     for it, path in snapshot_candidates(target):
+        if eligible is not None and not eligible(path):
+            continue
         try:
             load_state(path)
         except (SnapshotError, ValueError) as e:
@@ -73,6 +83,16 @@ def newest_verified(
             continue
         return it, path
     return None
+
+
+def gate_eligible_filter() -> Optional[Callable[[str], bool]]:
+    """The ``eligible`` predicate wired when ``SPARKNET_DEPLOY_GATE``
+    is on; None (no filtering) otherwise."""
+    from ..deploy import gate as _gate
+
+    if not _gate.gate_required():
+        return None
+    return lambda path: _gate.check_eligible(path)[0]
 
 
 class SnapshotWatcher:
@@ -109,7 +129,9 @@ class SnapshotWatcher:
         def torn(path, e):
             self.torn_seen += 1
 
-        got = newest_verified(self.target, on_torn=torn)
+        got = newest_verified(
+            self.target, on_torn=torn, eligible=gate_eligible_filter()
+        )
         if got is None or got[0] <= self.last_iter:
             return None
         it, path = got

@@ -110,6 +110,13 @@ def add_engine_args(ap: argparse.ArgumentParser) -> None:
              "shard per the training rule table (docs/PARALLELISM.md) "
              "and the compile cache keys include the layout",
     )
+    ap.add_argument(
+        "--tee-dir", default=None, metavar="DIR",
+        help="deploy traffic tee (deploy/tee.py): append served "
+             "rows + labels into a packed shard log under DIR — the "
+             "incremental trainer's input; bounded and non-blocking "
+             "(drops counted, never backpressures requests)",
+    )
 
 
 def build_stack(args, *, watch_in_server: bool = True):
@@ -177,6 +184,11 @@ def build_stack(args, *, watch_in_server: bool = True):
         from ..data.cache import ShmBatchCache
 
         data_cache = ShmBatchCache(namespace=args.data_cache, readonly=True)
+    tee = None
+    if getattr(args, "tee_dir", None):
+        from ..deploy.tee import TeeWriter
+
+        tee = TeeWriter(args.tee_dir)
     server = InferenceServer(
         engine,
         batcher=batcher,
@@ -188,6 +200,7 @@ def build_stack(args, *, watch_in_server: bool = True):
         data_cache=data_cache,
         watch=args.snapshot_watch if watch_in_server else None,
         compile_cache_info=cache_info,
+        tee=tee,
     )
     return engine, batcher, metrics, server
 

@@ -87,6 +87,16 @@ class Replica:
         # accounting for batched rows rides the same block the session
         # panel aggregates
         self.decode: Optional[dict] = None
+        # deploy surface (ISSUE 18): the generation this replica
+        # rolled back FROM (None = never rolled back) + its traffic
+        # tee counters, both off /healthz
+        self.rolled_back_from: Optional[str] = None
+        self.tee: Optional[dict] = None
+        # respawned since the tier last rolled: must be brought onto
+        # the serving weights before it becomes dispatchable again
+        # (a respawn boots on its spawn-time argv weights — serving
+        # those beside a rolled tier is a mixed-generation tier)
+        self.needs_resync = False
         self.pid: Optional[int] = None
         self.forwarded = 0
         self.latency = LatencyHistogram()
@@ -108,6 +118,8 @@ class Replica:
             "compile_cache": self.compile_cache,
             "session_cache": self.session_cache,
             "decode": self.decode,
+            "rolled_back_from": self.rolled_back_from,
+            "tee": self.tee,
             "pid": self.pid,
             "forwarded": self.forwarded,
             "latency": self.latency.snapshot(),
@@ -129,6 +141,7 @@ class RouterMetrics:
         self.replica_deaths = 0
         self.respawns = 0
         self.rolls = 0           # completed rolling hot-swaps
+        self.rollbacks = 0       # completed tier-wide rollbacks
         # stateful sessions whose holder changed (eject/kill/retry):
         # rebuilt on the new replica — correct by construction, but
         # every one is a cold rebuild and MUST be measurable
@@ -201,6 +214,7 @@ class RouterMetrics:
                 "replica_deaths": self.replica_deaths,
                 "respawns": self.respawns,
                 "rolls": self.rolls,
+                "rollbacks": self.rollbacks,
                 "session_migrations": self.session_migrations,
                 "request_latency": self.request_latency.snapshot(),
                 "admission": {
@@ -310,6 +324,14 @@ class Router:
         self._watch_target = watch
         self._watcher = None
         self._watch_interval_s = watch_interval_s
+        # deploy controller (deploy/controller.py), attached by
+        # tools/serve when --deploy-dir is set; surfaces on /healthz
+        self.deploy = None
+        # what the tier currently serves (last successful roll /
+        # roll_back target): respawned replicas are re-synced onto
+        # this before rejoining dispatch — None until the first roll
+        # (boot weights ARE the serving generation then)
+        self._serving_weights: Optional[str] = None
 
         outer = self
 
@@ -770,6 +792,36 @@ class Router:
             doc = json.loads(payload or b"{}")
         except (OSError, http.client.HTTPException, ValueError):
             status, doc = 0, {}
+        if status == 200 and rep.needs_resync:
+            # a respawn boots on its spawn-time argv weights; if the
+            # tier rolled while it was down, reload it onto the
+            # serving generation BEFORE it becomes dispatchable —
+            # otherwise the tier serves mixed generations until the
+            # next roll (and a post-rollback respawn could resurrect
+            # the exact weights the watch rolled back)
+            target = self._serving_weights
+            if target is not None and doc.get("weights_source") != target:
+                # one replica out at a time: a resync is a reload like
+                # any other — never run it beside a rolling sweep
+                if not self._roll_lock.acquire(blocking=False):
+                    return  # roll in flight; retry next tick
+                try:
+                    st2, pay2, _ = self._replica_request(
+                        rep, "POST", "/reload",
+                        json.dumps({"weights": target}).encode(),
+                    )
+                    doc2 = json.loads(pay2 or b"{}")
+                except (OSError, http.client.HTTPException, ValueError):
+                    st2, doc2 = 0, {}
+                finally:
+                    self._roll_lock.release()
+                if st2 != 200:
+                    return  # stays out of dispatch; retry next tick
+                doc["generation"] = doc2.get(
+                    "generation", doc.get("generation")
+                )
+                doc["weights_source"] = target
+            rep.needs_resync = False
         with self._lock:
             if status == 200:
                 rep.consecutive_fails = 0
@@ -783,6 +835,8 @@ class Router:
                 rep.compile_cache = doc.get("compile_cache")
                 rep.session_cache = doc.get("session_cache")
                 rep.decode = doc.get("decode")
+                rep.rolled_back_from = doc.get("rolled_back_from")
+                rep.tee = doc.get("tee")
                 rep.pid = doc.get("pid")
             else:
                 rep.consecutive_fails += 1
@@ -835,6 +889,8 @@ class Router:
                         self.replicas[ev["child"]].healthy = False
                 elif ev["event"] == "spawn" and ev["spawn"] > 1:
                     self.metrics.inc("respawns")
+                    with self._lock:
+                        self.replicas[ev["child"]].needs_resync = True
                     from .. import chaos
 
                     chaos.record_recovery("serve.replica_respawn")
@@ -858,16 +914,33 @@ class Router:
             if weights is None and self._watch_target is not None:
                 from . import hotswap
 
-                got = hotswap.newest_verified(self._watch_target)
+                got = hotswap.newest_verified(
+                    self._watch_target,
+                    eligible=hotswap.gate_eligible_filter(),
+                )
                 if got is None:
                     return 409, {
-                        "error": "no intact solverstate under "
+                        "error": "no intact eligible solverstate under "
                                  f"{self._watch_target!r}"
                     }
                 weights = got[1]
             if not weights:
                 return 400, {"error": "no weights given and no "
                                       "snapshot watch configured"}
+            # deploy-gate pre-check (ISSUE 18): with gating on, an
+            # ungated/rejected/rolled-back snapshot is a 409 HERE — no
+            # replica is ever even asked to load it
+            if ".solverstate." in os.path.basename(weights):
+                from ..deploy import gate as _gate
+
+                if _gate.gate_required():
+                    ok, reason = _gate.check_eligible(weights)
+                    if not ok:
+                        return 409, {
+                            "error": f"deploy gate: "
+                                     f"{os.path.basename(weights)}: "
+                                     f"{reason}"
+                        }
             rolled, errors = [], []
             for rep in list(self.replicas):
                 with self._lock:
@@ -893,11 +966,20 @@ class Router:
                         f"{doc.get('error')}"
                     )
                     break
+                # this replica is ON the roll target now; without
+                # this, the probe below would re-sync it backwards
+                # (``_serving_weights`` still names the pre-roll
+                # generation until the sweep finishes)
+                rep.needs_resync = False
                 self._probe(rep)  # pick up the new generation verdict
                 rolled.append(
                     {"replica": rep.index,
                      "generation": doc.get("generation")}
                 )
+            if rolled:
+                # the tier target even on a partial roll: respawned
+                # replicas re-sync onto this, converging the tier
+                self._serving_weights = weights
             if rolled and not errors:
                 self.metrics.inc("rolls")
             code = 200 if rolled and not errors else 502
@@ -905,6 +987,60 @@ class Router:
                 "rolled": rolled,
                 "errors": errors,
                 "source": weights,
+            }
+
+    def roll_back(self, reason: str = "") -> Tuple[int, dict]:
+        """Tier-wide rollback to each replica's resident previous
+        generation (engine.rollback — O(1) pointer exchange, no file
+        I/O, no recompile).  Unlike :meth:`roll`, errors do NOT stop
+        the sweep: when a bad generation is serving, rolling back as
+        many replicas as possible beats stopping at the first
+        failure."""
+        with self._roll_lock:
+            rolled, errors = [], []
+            for rep in list(self.replicas):
+                with self._lock:
+                    ok = rep.healthy and rep.port is not None
+                if not ok:
+                    continue
+                try:
+                    status, payload, _ = self._replica_request(
+                        rep, "POST", "/reload",
+                        json.dumps({"rollback": True}).encode(),
+                    )
+                    doc = json.loads(payload or b"{}")
+                except (OSError, http.client.HTTPException, ValueError) as e:
+                    errors.append(
+                        f"replica {rep.index}: {type(e).__name__}: {e}"
+                    )
+                    continue
+                if status != 200:
+                    errors.append(
+                        f"replica {rep.index}: HTTP {status}: "
+                        f"{doc.get('error')}"
+                    )
+                    continue
+                rep.needs_resync = False  # on the rollback target now
+                self._probe(rep)
+                rolled.append(
+                    {"replica": rep.index,
+                     "generation": doc.get("generation"),
+                     "source": doc.get("source")}
+                )
+            if rolled:
+                self.metrics.inc("rollbacks", event="rollback")
+                # retarget respawn re-sync at what the tier serves
+                # NOW — re-syncing onto the rolled-back source would
+                # resurrect the bad generation (and the gate ledger
+                # would 409 it anyway); source None (boot weights)
+                # disables re-sync, which is exactly right: a respawn
+                # boots on those same weights
+                self._serving_weights = rolled[0].get("source")
+            code = 200 if rolled and not errors else (502 if errors else 409)
+            return code, {
+                "rolled_back": rolled,
+                "errors": errors,
+                "reason": reason,
             }
 
     def _on_new_snapshot(self, it: int, path: str) -> None:
@@ -1054,6 +1190,10 @@ class Router:
             "replicas_draining": draining,
             "generations": sorted(g for g in gens if g is not None),
             "replicas": reps,
+            **(
+                {"deploy": self.deploy.snapshot()}
+                if self.deploy is not None else {}
+            ),
         }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -1085,6 +1225,12 @@ class Router:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.deploy is not None:
+            try:
+                self.deploy.stop()
+            except Exception:
+                pass
+            self.deploy = None
         if self._watcher is not None:
             self._watcher.stop()
             self._watcher = None

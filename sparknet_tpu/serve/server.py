@@ -93,6 +93,7 @@ class InferenceServer:
         watch: Optional[str] = None,
         watch_interval_s: float = 2.0,
         compile_cache_info: Optional[dict] = None,
+        tee=None,
     ):
         """``port=0`` binds an ephemeral port (tests); the bound port is
         ``self.port`` either way.  ``data_cache``: an attached (read-
@@ -107,6 +108,10 @@ class InferenceServer:
         self.engine = engine
         self.data_cache = data_cache
         self.compile_cache_info = compile_cache_info
+        # deploy traffic tee (deploy/tee.py): served rows + labels
+        # stream into a packed shard log.  Strictly fire-and-forget
+        # from the request path — offer() never blocks or raises.
+        self.tee = tee
         self._watch_target = watch
         self._watch_interval_s = watch_interval_s
         self._watcher = None
@@ -196,8 +201,13 @@ class InferenceServer:
                         "warmup_s": getattr(
                             outer.engine, "warmup_s", None
                         ),
+                        "rolled_back_from": getattr(
+                            outer.engine, "rolled_back_from", None
+                        ),
                         "pid": os.getpid(),
                     }
+                    if outer.tee is not None:
+                        payload["tee"] = outer.tee.stats()
                     if outer.compile_cache_info is not None:
                         payload["compile_cache"] = outer.compile_cache_info
                     if outer.data_cache is not None:
@@ -278,7 +288,10 @@ class InferenceServer:
                     except ValueError as e:
                         self._reply(400, {"error": f"bad request: {e}"})
                         return
-                    code, payload = outer.reload(req.get("weights"))
+                    code, payload = outer.reload(
+                        req.get("weights"),
+                        rollback=bool(req.get("rollback")),
+                    )
                     self._reply(code, payload)
                     return
                 if self.path == "/generate":
@@ -429,6 +442,24 @@ class InferenceServer:
                     )
                     return
                 idx, probs = outer.engine.postprocess(out, top_k)
+                if outer.tee is not None and isinstance(
+                    rows, np.ndarray
+                ):
+                    # tee served samples into the training log: caller
+                    # labels when given, else the served top-1 (weak
+                    # self-label).  offer() is O(1) and drop-counted —
+                    # it can never backpressure this path.
+                    labels = req.get("labels")
+                    for i in range(len(rows)):
+                        y = (
+                            labels[i] if labels is not None
+                            and i < len(labels)
+                            else idx[i][0]
+                        )
+                        outer.tee.offer({
+                            "data": rows[i],
+                            "label": np.int32(y),
+                        })
                 payload = {
                     "indices": idx.tolist(),
                     "probs": probs.tolist(),
@@ -571,17 +602,36 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
-    def reload(self, weights: Optional[str] = None):
+    def reload(
+        self, weights: Optional[str] = None, *, rollback: bool = False
+    ):
         """Hot-swap the engine's weights; returns ``(http_code,
         payload)`` (the ``/reload`` route's contract, also callable
         in-process).  No explicit path + a snapshot watch configured
-        picks the newest manifest-verified solverstate under the watch
-        target.  Serialized under a lock: concurrent reloads would
+        picks the newest manifest-verified (and, with the deploy gate
+        on, gate-eligible) solverstate under the watch target.
+        ``rollback=True`` ignores ``weights`` and swaps back to the
+        engine's resident previous generation (409 when none is
+        resident — e.g. a second rollback without an intervening
+        swap).  Serialized under a lock: concurrent reloads would
         interleave generations."""
+        from ..deploy.gate import DeployGateError
         from ..solver.snapshot import SnapshotError
         from . import hotswap
 
         with self._reload_lock:
+            if rollback:
+                try:
+                    gen = self.engine.rollback()
+                except ValueError as e:
+                    return 409, {"error": str(e)}
+                return 200, {
+                    "generation": gen,
+                    "rolled_back": True,
+                    "source": getattr(
+                        self.engine, "weights_source", None
+                    ),
+                }
             path = weights
             if not path:
                 if not self._watch_target:
@@ -589,15 +639,23 @@ class InferenceServer:
                         "error": "no weights given and no snapshot "
                                  "watch configured"
                     }
-                got = hotswap.newest_verified(self._watch_target)
+                got = hotswap.newest_verified(
+                    self._watch_target,
+                    eligible=hotswap.gate_eligible_filter(),
+                )
                 if got is None:
                     return 409, {
-                        "error": "no intact solverstate under "
+                        "error": "no intact eligible solverstate under "
                                  f"{self._watch_target!r}"
                     }
                 path = got[1]
             try:
                 gen = self.engine.swap_from_file(path)
+            except DeployGateError as e:
+                # the deploy gate (ISSUE 18): manifest-intact but
+                # ungated/failed/rolled-back snapshots are refused
+                # exactly like torn ones — the old generation serves on
+                return 409, {"error": f"deploy gate: {e}"}
             except SnapshotError as e:
                 # the PR 3 verification gate: torn file -> the old
                 # generation keeps serving, the caller hears why

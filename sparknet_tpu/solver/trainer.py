@@ -501,15 +501,26 @@ class Solver:
 
     def load_weights(self, path: str) -> None:
         """Caffe's ``--weights`` finetuning path: overlay each listed
-        ``.caffemodel``'s blobs (comma-separated like the caffe binary;
-        later files win on overlap) onto the initialised params/state;
-        optimizer state is untouched."""
+        artifact's blobs (comma-separated like the caffe binary; later
+        files win on overlap) onto the initialised params/state;
+        optimizer state is untouched.  Accepts ``.caffemodel`` weight
+        files or full ``.solverstate.npz``/``.orbax`` snapshots — the
+        latter are manifest-verified and contribute only their params +
+        net state (BN stats) while iteration/optimizer/PRNG stay fresh
+        (the deploy trainer's first generation starts FROM the serving
+        baseline this way)."""
         from ..proto import caffemodel as cm
+        from . import snapshot
 
         p = jax.device_get(self.params)
         s = jax.device_get(self.state)
         for one in path.split(","):
-            imported, st = cm.import_caffemodel(one.strip(), self.train_net)
+            one = one.strip()
+            if one.endswith((snapshot.NPZ_SUFFIX, snapshot.ORBAX_SUFFIX)):
+                loaded = snapshot.load_state(one)
+                imported, st = loaded["params"], loaded.get("state") or {}
+            else:
+                imported, st = cm.import_caffemodel(one, self.train_net)
             p = cm.merge_into(p, imported)
             s = cm.merge_into(s, st)
         # opt_state untouched: it may be non-addressable (multi-host

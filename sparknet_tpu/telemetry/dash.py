@@ -248,6 +248,62 @@ def _router_section(router: dict) -> str:
     )
 
 
+def _deploy_section(deploy: dict) -> str:
+    """Deployment timeline (router /dash, ISSUE 18): the closed-loop
+    controller's state — baseline generation, watch window, rollback
+    latency — plus the event feed (roll / gate_reject / rollback /
+    watch_pass), newest first."""
+    watch = deploy.get("watch") or {}
+    trainer = deploy.get("trainer") or {}
+    if watch.get("armed"):
+        watch_label = f"armed, {watch.get('remaining_s', 0):g} s left"
+        watch_sub = os.path.basename(str(watch.get("source") or ""))
+    else:
+        watch_label = "idle"
+        watch_sub = watch.get("fired_reason") or ""
+    rb_ms = deploy.get("last_rollback_ms")
+    tiles = [
+        _tile("baseline", _esc(deploy.get("baseline") or "boot"),
+              f"gate @ iter {deploy.get('last_gated_iter', -1)}"),
+        _tile("rolls", str(deploy.get("rolls", 0)),
+              f"{deploy.get('rollbacks', 0)} rollbacks"),
+        _tile("watch", watch_label, _esc(watch_sub)),
+        _tile("rollback latency",
+              f"{rb_ms:.0f} ms" if rb_ms is not None else "—",
+              "resident-previous pointer exchange"),
+    ]
+    if trainer:
+        alive = trainer.get("alive", 0)
+        tiles.append(
+            _tile("trainer", f"{alive} alive",
+                  f"{sum(c.get('spawns', 0) for c in trainer.get('children', []))} spawns"),
+        )
+    items = []
+    for e in reversed(list(deploy.get("events") or [])[-20:]):
+        action = str(e.get("action", "?"))
+        sev = {
+            "rollback": "serious", "roll_failed": "serious",
+            "gate_reject": "warning", "trainer_exit": "warning",
+        }.get(action, "good")
+        when = time.strftime("%H:%M:%S", time.localtime(e.get("t", 0)))
+        items.append(
+            f'<li><span class="status-{sev}">'
+            f'{"▲" if sev != "good" else "●"} {_esc(action)}</span> '
+            f'<span class="muted">{_esc(when)}</span> '
+            f"{_esc(e.get('detail', ''))}</li>"
+        )
+    feed = (
+        f'<ul class="feed">{"".join(items)}</ul>' if items
+        else '<p class="muted">no deploy events yet</p>'
+    )
+    return (
+        '<section><h2>Deployment <span class="muted">'
+        "(tee → train → gate → roll → watch; docs/SERVING.md"
+        ' "Model lifecycle")</span></h2>'
+        f'<div class="tiles">{"".join(tiles)}</div>{feed}</section>'
+    )
+
+
 def _session_section(session: dict, decode: Optional[dict] = None) -> str:
     """Session-cache panel (ISSUE 13): hit/miss/evict/stale-gen tiles
     from the ``session_cache`` registry source (a replica's own cache)
@@ -516,6 +572,7 @@ def render_html(
   <span class="muted">rendered {time.strftime('%H:%M:%S')}, refreshes every {refresh_s}s</span>
 </header>
 {_router_section(router) if router is not None else ''}
+{_deploy_section(router.get("deploy")) if router and router.get("deploy") else ''}
 {_session_section(session, decode) if session else ''}
 {_reqtrace_section(reqtrace) if reqtrace else ''}
 <section><h2>Serving</h2><div class="tiles">{''.join(tiles)}</div></section>

@@ -83,6 +83,28 @@ def main(argv=None):
              "temp dir)",
     )
     ap.add_argument(
+        "--deploy-dir", default=None, metavar="DIR",
+        help="router mode: close the loop (deploy/controller.py) — "
+             "replicas tee served traffic into DIR/log, an incremental "
+             "trainer emits candidates into DIR/candidates, and each "
+             "candidate is eval-gated, rolled, watched, and "
+             "auto-rolled-back on SLO burn or agreement regression",
+    )
+    ap.add_argument(
+        "--deploy-train-net", default=None, metavar="PATH",
+        help="TRAIN .prototxt for the deploy trainer (Input data/label "
+             "+ loss twin of --model); required with --deploy-dir",
+    )
+    ap.add_argument(
+        "--deploy-interval-s", type=float, default=1.0,
+        help="deploy controller tick cadence",
+    )
+    ap.add_argument(
+        "--deploy-no-trainer", action="store_true",
+        help="deploy loop without the supervised trainer child "
+             "(candidates arrive from elsewhere; tests/smokes)",
+    )
+    ap.add_argument(
         "--health-interval-s", type=float, default=0.5,
         help="router health-sweep cadence",
     )
@@ -118,6 +140,17 @@ def main(argv=None):
                  "ceiling, --replicas the floor)")
     if args.autoscale_max and args.replicas < 1:
         ap.error("--autoscale-max needs router mode (--replicas >= 1)")
+
+    if args.deploy_dir:
+        if args.replicas < 1:
+            ap.error("--deploy-dir needs router mode (--replicas >= 1):"
+                     " the rollback is a tier-wide roll")
+        if not args.deploy_train_net:
+            ap.error("--deploy-dir needs --deploy-train-net (the TRAIN "
+                     "prototxt the incremental trainer optimizes)")
+        if getattr(args, "tee_dir", None):
+            ap.error("--deploy-dir owns the tee (DIR/log); drop "
+                     "--tee-dir")
 
     if args.replicas > 0:
         return _run_router(args)
@@ -189,6 +222,14 @@ def _replica_argv(args, run_dir: str, index: int, spawn: int):
         argv += ["--data-cache", args.data_cache]
     if getattr(args, "session_cache_mb", None) is not None:
         argv += ["--session-cache-mb", str(args.session_cache_mb)]
+    # closed loop: every replica tees its served traffic into the
+    # shared deploy log (deploy/tee.py is multi-writer safe: each
+    # writer owns distinctly-seeded shard names via its pid)
+    tee = getattr(args, "tee_dir", None)
+    if getattr(args, "deploy_dir", None):
+        tee = os.path.join(args.deploy_dir, "log")
+    if tee:
+        argv += ["--tee-dir", tee]
     # NOTE: --snapshot-watch is deliberately NOT forwarded — under a
     # router the roll is router-driven, one replica at a time
     return argv
@@ -245,10 +286,27 @@ def _run_router(args):
                 max_replicas=args.autoscale_max,
             ),
         )
+    deploy = None
+    if args.deploy_dir:
+        from ..deploy.controller import DeployController
+
+        deploy = DeployController(
+            router,
+            deploy_dir=args.deploy_dir,
+            model=args.model,
+            train_net=args.deploy_train_net,
+            boot_weights=args.weights,
+            interval_s=args.deploy_interval_s,
+            run_trainer=not args.deploy_no_trainer,
+        )
+        router.deploy = deploy
     pool.start()
     router.start()
     if controller is not None:
         controller.start()
+    if deploy is not None:
+        deploy.start()  # after router.start(): the probe replays need
+        # the router's bound port
     if args.portfile:
         # reuse the replica portfile shape; the router has no engine
         write_portfile(
@@ -265,7 +323,8 @@ def _run_router(args):
         f"{len(pool.alive())}/{args.replicas} replicas "
         f"{'healthy' if ok else 'NOT all healthy'} "
         f"(run_dir={run_dir}"
-        f"{auto}{', admission on' if admission else ''})",
+        f"{auto}{', admission on' if admission else ''}"
+        f"{', deploy loop on' if deploy is not None else ''})",
         flush=True,
     )
     try:

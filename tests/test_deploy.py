@@ -1,0 +1,687 @@
+"""Closed-loop deploy pipeline (ISSUE 18): traffic tee, incremental
+trainer, eval gate, gated rolls, burn/regression auto-rollback.
+
+The full e2e (real tier, seeded traffic, gated roll, chaos regression,
+auto-rollback with zero failed requests) lives in
+scripts/closed_loop_smoke.py (check.sh); these tests pin each
+contract fast and CPU-only:
+
+- ``TeeWriter.offer`` never blocks and never raises — a stalled drain
+  drops (counted), the request path pays O(1);
+- a crashed tee leaves a torn tail that :func:`recover_log`
+  quarantines (the ``data.torn_shard`` discipline) while intact
+  orphans are adopted;
+- trainer restart == continuous training, bitwise, via shard-level
+  ``skip(n)`` log-head resume;
+- the gate passes agreeing candidates, quarantines poisoned/regressed
+  ones with machine-readable verdicts, and the ineligibility ledger
+  keeps a rolled-back digest out forever;
+- with ``SPARKNET_DEPLOY_GATE`` on, an ungated snapshot is refused at
+  every layer: engine (DeployGateError), server /reload (409), router
+  roll (409), snapshot watcher (skipped);
+- ``engine.rollback()`` restores the resident previous generation
+  bitwise and is one-deep (double rollback -> error / 409);
+- :class:`RollbackWatch` fires exactly once per armed window.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu import chaos
+from sparknet_tpu.chaos.plan import FaultPlan
+from sparknet_tpu.data import records as rec
+from sparknet_tpu.deploy import gate
+from sparknet_tpu.deploy.controller import DeployController
+from sparknet_tpu.deploy.rollback import RollbackWatch
+from sparknet_tpu.deploy.tee import TeeWriter, recover_log
+from sparknet_tpu.deploy.trainer import IncrementalTrainer
+from sparknet_tpu.serve.engine import InferenceEngine
+from sparknet_tpu.serve.server import InferenceServer
+from sparknet_tpu.solver.snapshot import save_state
+
+TRAIN_NET = """
+name: "tiny"
+layer { name: "d" type: "Input" top: "data" top: "label" }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+        bottom: "label" top: "loss" }
+"""
+
+DEPLOY_NET = """
+name: "tiny"
+input: "data"
+input_shape { dim: 1 dim: 8 }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, 8)).astype(
+        np.float32
+    )
+
+
+def _sample(i, seed=0):
+    rng = np.random.default_rng(seed + i)
+    return {
+        "data": rng.normal(size=(8,)).astype(np.float32),
+        "label": np.int32(rng.integers(0, 4)),
+    }
+
+
+def _write_nets(tmp_path):
+    train = str(tmp_path / "train.prototxt")
+    deploy = str(tmp_path / "deploy.prototxt")
+    with open(train, "w") as fh:
+        fh.write(TRAIN_NET)
+    with open(deploy, "w") as fh:
+        fh.write(DEPLOY_NET)
+    return train, deploy
+
+
+def _tiny_engine(deploy, seed=7):
+    from sparknet_tpu.nets.xlanet import XLANet
+    from sparknet_tpu.proto import caffe_pb
+
+    net = XLANet(caffe_pb.load_net(DEPLOY_NET, is_path=False), "TEST")
+    params, state = net.init(jax.random.PRNGKey(seed))
+    return InferenceEngine(net, params, state, buckets=(4,))
+
+
+def _solverstate(tmp_path, name, engine):
+    path = str(tmp_path / name)
+    save_state(
+        path,
+        params=jax.device_get(engine.params),
+        state=jax.device_get(engine.state),
+    )
+    return path
+
+
+# ------------------------------------------------------------ chaos grammar
+def test_deploy_fault_points_parse():
+    p = FaultPlan(
+        "deploy.poison_snapshot@iter=4:frac=0.3,"
+        "deploy.regressed_weights@index=1:frac=8"
+    )
+    assert p.points() == [
+        "deploy.poison_snapshot", "deploy.regressed_weights"
+    ]
+    rule = p.match("deploy.poison_snapshot", index=0, iter=4)
+    assert rule is not None and rule.params["frac"] == 0.3
+    rule = p.match("deploy.regressed_weights", index=1)
+    assert rule is not None and rule.params["frac"] == 8
+    assert p.match("deploy.regressed_weights", index=0) is None
+
+
+# ------------------------------------------------------------------- tee
+def test_tee_offer_never_blocks_and_drops_are_counted(tmp_path):
+    tee = TeeWriter(str(tmp_path), capacity=64, interval_s=60.0)
+    try:
+        with tee._io_lock:  # stall the drain: worst case for offer()
+            t0 = time.monotonic()
+            results = [tee.offer(_sample(i)) for i in range(200)]
+            dt = time.monotonic() - t0
+        assert results.count(True) == 64
+        assert results.count(False) == 136
+        assert tee.offered == 64 and tee.dropped == 136
+        # the request path pays deque-append + counter, nothing else:
+        # 200 offers against a stalled drain finish in well under the
+        # <=2% latency budget of any real request
+        assert dt / 200 < 1e-3
+        tee.flush()
+        ds = rec.PackedDataset(str(tmp_path))
+        assert ds.num_records == 64
+    finally:
+        tee.stop()
+
+
+def test_tee_log_survives_torn_tail_and_adopts_orphans(tmp_path):
+    # an intact shard missing from the manifest (crash between finish
+    # and manifest publish) is adopted; a torn tail is quarantined
+    w = rec.ShardWriter(str(tmp_path / f"shard-{os.getpid()}-00000.snpk"))
+    for i in range(6):
+        w.add(_sample(i))
+    w.finish()
+    torn = str(tmp_path / f"shard-{os.getpid()}-00001.snpk")
+    w2 = rec.ShardWriter(torn)
+    for i in range(6):
+        w2.add(_sample(i))
+    w2.finish()
+    with open(torn, "rb+") as fh:
+        fh.truncate(os.path.getsize(torn) // 2)
+    summary = recover_log(str(tmp_path))
+    assert len(summary["adopted"]) == 1
+    assert summary["quarantined"] == [os.path.basename(torn)]
+    assert not os.path.exists(torn)
+    assert os.path.exists(torn + ".quarantined")
+    assert rec.PackedDataset(str(tmp_path)).num_records == 6
+    # idempotent: a second recovery changes nothing
+    again = recover_log(str(tmp_path))
+    assert not again["adopted"] and not again["quarantined"]
+
+
+def test_multiple_tee_writers_share_one_log(tmp_path):
+    # pid-scoped shard names + merge-on-publish manifests: two writers
+    # in one process stand in for two replica processes
+    a = TeeWriter(str(tmp_path), interval_s=60.0)
+    b = TeeWriter(str(tmp_path), interval_s=60.0)
+    try:
+        for i in range(4):
+            a.offer(_sample(i))
+        a.flush()
+        for i in range(4, 8):
+            b.offer(_sample(i))
+        b.flush()
+        for i in range(8, 12):
+            a.offer(_sample(i))
+        a.flush()
+    finally:
+        a.stop()
+        b.stop()
+    recover_log(str(tmp_path))
+    assert rec.PackedDataset(str(tmp_path)).num_records == 12
+
+
+# ------------------------------------------------------- trainer resume
+def test_trainer_restart_is_bitwise_equal_to_continuous(tmp_path):
+    train, _ = _write_nets(tmp_path)
+    log = str(tmp_path / "log")
+    tee = TeeWriter(log, interval_s=60.0)
+    try:
+        for i in range(16):
+            tee.offer(_sample(i))
+        tee.flush()
+
+        out_ab = str(tmp_path / "cand_ab")
+        tr_a = IncrementalTrainer(log, train, out_ab, batch_size=4, seed=0)
+        first = tr_a.run_once()
+        assert first and first.endswith("_iter_4.solverstate.npz")
+        assert tr_a.run_once() is None  # at the head: nothing new
+
+        # the log grows while the trainer is "down"
+        for i in range(16, 32):
+            tee.offer(_sample(i))
+        tee.flush()
+    finally:
+        tee.stop()
+
+    # restart: a NEW trainer restores iter 4 and trains to the head
+    tr_b = IncrementalTrainer(log, train, out_ab, batch_size=4, seed=0)
+    second = tr_b.run_once()
+    assert second and second.endswith("_iter_8.solverstate.npz")
+
+    # continuous reference: one trainer sees the full log at once
+    out_c = str(tmp_path / "cand_c")
+    tr_c = IncrementalTrainer(log, train, out_c, batch_size=4, seed=0)
+    ref = tr_c.run_once()
+    assert ref and ref.endswith("_iter_8.solverstate.npz")
+
+    from sparknet_tpu.solver.snapshot import load_state
+
+    sa, sc = load_state(second), load_state(ref)
+    assert int(np.asarray(sa["it"])) == int(np.asarray(sc["it"])) == 8
+    la = jax.tree_util.tree_leaves(sa["params"])
+    lc = jax.tree_util.tree_leaves(sc["params"])
+    assert la and len(la) == len(lc)
+    for x, y in zip(la, lc):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_trainer_first_generation_inits_from_serving_solverstate(tmp_path):
+    # the controller hands the trainer the serving baseline (a full
+    # .solverstate.npz) as --init-weights; Solver.load_weights must
+    # overlay its params rather than choke on a non-caffemodel file
+    train, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy, seed=99)
+    boot = _solverstate(tmp_path, "boot_iter_1.solverstate.npz", eng)
+    log = str(tmp_path / "log")
+    tee = TeeWriter(log, interval_s=60.0)
+    try:
+        for i in range(2):  # < batch_size: solver builds, zero steps
+            tee.offer(_sample(i))
+        tee.flush()
+    finally:
+        tee.stop()
+    tr = IncrementalTrainer(
+        log, train, str(tmp_path / "cand"),
+        batch_size=4, seed=0, init_weights=boot,
+    )
+    assert tr.run_once() is None  # no full batch yet
+    from sparknet_tpu.solver.snapshot import load_state
+
+    want = jax.tree_util.tree_leaves(load_state(boot)["params"])
+    leaves = jax.tree_util.tree_leaves(tr._solver.params)
+    assert leaves and len(leaves) == len(want)
+    for x, y in zip(leaves, want):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert tr._solver.iter == 0  # iteration IS the log position
+
+
+# ---------------------------------------------------------------- gate
+def test_gate_passes_agreeing_candidate_and_saves_probe(tmp_path):
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy)
+    baseline = _solverstate(tmp_path, "base_iter_1.solverstate.npz", eng)
+    cand = _solverstate(tmp_path, "inc_iter_2.solverstate.npz", eng)
+    v = gate.evaluate(
+        cand, model=deploy, baseline_weights=baseline, probe=_rows(4)
+    )
+    assert v["verdict"] == "pass" and v["disagree_pct"] == 0.0
+    ok, reason = gate.check_eligible(cand)
+    assert ok, reason
+    saved = gate.load_probe(cand)
+    assert saved is not None and len(saved["expected_top1"]) == 4
+
+
+def test_gate_rejects_disagreeing_candidate_and_quarantines(tmp_path):
+    _, deploy = _write_nets(tmp_path)
+    baseline = _solverstate(
+        tmp_path, "base_iter_1.solverstate.npz", _tiny_engine(deploy, 7)
+    )
+    cand = _solverstate(
+        tmp_path, "inc_iter_2.solverstate.npz", _tiny_engine(deploy, 99)
+    )
+    v = gate.evaluate(
+        cand, model=deploy, baseline_weights=baseline, probe=_rows(16)
+    )
+    assert v["verdict"] == "fail" and "disagreement" in v["reason"]
+    assert not os.path.exists(cand)  # quarantined out of the glob
+    assert os.path.exists(cand + gate.QUARANTINE_SUFFIX)
+    # the verdict record survives at the original name for the audit
+    assert gate.read_verdict(cand)["verdict"] == "fail"
+    assert not gate.check_eligible(cand)[0]
+
+
+def test_poisoned_candidate_is_quarantined_never_served(tmp_path):
+    """deploy.poison_snapshot chaos: the candidate is corrupted before
+    the gate looks — manifest verification catches it, the file is
+    quarantined, and nothing could ever roll it."""
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy)
+    baseline = _solverstate(tmp_path, "base_iter_1.solverstate.npz", eng)
+    cand = _solverstate(tmp_path, "inc_iter_2.solverstate.npz", eng)
+    chaos.install_from("deploy.poison_snapshot@times=1:frac=0.5")
+    v = gate.evaluate(
+        cand, model=deploy, baseline_weights=baseline, probe=_rows(4)
+    )
+    assert v["verdict"] == "fail"
+    assert "manifest verify failed" in v["reason"]
+    assert "chaos poisoned" in v["reason"]
+    assert not os.path.exists(cand)
+    assert os.path.exists(cand + gate.QUARANTINE_SUFFIX)
+    assert gate.check_eligible(cand)[0] is False
+
+
+def test_ineligibility_ledger_blocks_redeploy(tmp_path):
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy)
+    baseline = _solverstate(tmp_path, "base_iter_1.solverstate.npz", eng)
+    cand = _solverstate(tmp_path, "inc_iter_2.solverstate.npz", eng)
+    v = gate.evaluate(
+        cand, model=deploy, baseline_weights=baseline, probe=_rows(4)
+    )
+    assert v["verdict"] == "pass"
+    digest = gate.mark_ineligible(cand, reason="slo_burn")
+    assert digest == v["digest"]
+    ok, reason = gate.check_eligible(cand)
+    assert not ok and "ineligible" in reason
+    # machine-checkable: the ledger carries the digest + reason
+    ledger = gate.load_ledger(str(tmp_path))
+    assert ledger["ineligible"][digest]["reason"] == "slo_burn"
+    # re-gating the same bytes refuses too — only a NEW snapshot can
+    v2 = gate.evaluate(
+        cand, model=deploy, baseline_weights=baseline, probe=_rows(4),
+        do_quarantine=False,
+    )
+    assert v2["verdict"] == "fail" and "ineligible" in v2["reason"]
+
+
+# ------------------------------------------------ gate enforcement layers
+def test_ungated_snapshot_refused_at_engine_server_and_watcher(
+    tmp_path, monkeypatch
+):
+    """ISSUE 18 satellite fix: the verdict is threaded through
+    swap_from_file — with gating on, an unverified-or-ungated snapshot
+    is a DeployGateError at the engine and a 409 at the server, and
+    the watcher skips it instead of parking."""
+    from sparknet_tpu.serve import hotswap
+
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy).warmup()
+    baseline = _solverstate(tmp_path, "base_iter_1.solverstate.npz", eng)
+    gated = _solverstate(tmp_path, "inc_iter_2.solverstate.npz", eng)
+    ungated = _solverstate(tmp_path, "inc_iter_9.solverstate.npz", eng)
+    assert gate.evaluate(
+        gated, model=deploy, baseline_weights=baseline, probe=_rows(4)
+    )["verdict"] == "pass"
+
+    monkeypatch.setenv("SPARKNET_DEPLOY_GATE", "require")
+    with pytest.raises(gate.DeployGateError, match="ungated"):
+        eng.swap_from_file(ungated)
+    assert eng.generation == 0  # the old weights keep serving
+
+    srv = InferenceServer(eng, port=0)
+    code, doc = srv.reload(ungated)
+    assert code == 409 and "deploy gate" in doc["error"]
+    code, doc = srv.reload(gated)
+    assert code == 200 and doc["generation"] == 1
+
+    # the watcher falls through the ungated newest to the gated one
+    got = hotswap.newest_verified(
+        str(tmp_path), eligible=hotswap.gate_eligible_filter()
+    )
+    assert got is not None and got[1] == gated
+    monkeypatch.delenv("SPARKNET_DEPLOY_GATE")
+    got = hotswap.newest_verified(
+        str(tmp_path), eligible=hotswap.gate_eligible_filter()
+    )
+    assert got is not None and got[1] == ungated  # gate off: no filter
+
+
+def test_router_roll_refuses_ungated_snapshot_with_409(
+    tmp_path, monkeypatch
+):
+    from sparknet_tpu.serve.router import Router
+
+    _, deploy = _write_nets(tmp_path)
+    ungated = _solverstate(
+        tmp_path, "inc_iter_3.solverstate.npz", _tiny_engine(deploy)
+    )
+    monkeypatch.setenv("SPARKNET_DEPLOY_GATE", "1")
+    router = Router([("127.0.0.1", 1)], health_interval_s=9999.0)
+    try:
+        code, doc = router.roll(ungated)
+        assert code == 409
+        assert "deploy gate" in doc["error"] and "ungated" in doc["error"]
+    finally:
+        router.stop()
+
+
+# ------------------------------------------------------------- rollback
+def test_engine_rollback_restores_previous_generation_bitwise(tmp_path):
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy, seed=7).warmup()
+    rows = _rows(4)
+    out0 = np.asarray(eng.infer(rows))
+    other = _tiny_engine(deploy, seed=99)
+    eng.swap(other.params, other.state)
+    out1 = np.asarray(eng.infer(rows))
+    assert not np.array_equal(out0, out1)
+    gen = eng.generation
+    assert eng.rollback() == gen + 1  # a rollback is still a new gen
+    np.testing.assert_array_equal(np.asarray(eng.infer(rows)), out0)
+    # one-deep: the consumed previous cannot be rolled back to twice
+    with pytest.raises(ValueError, match="no previous generation"):
+        eng.rollback()
+
+
+def test_server_reload_rollback_maps_to_409_when_spent(tmp_path):
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy).warmup()
+    other = _tiny_engine(deploy, seed=42)
+    eng.swap(other.params, other.state)
+    srv = InferenceServer(eng, port=0)
+    code, doc = srv.reload(rollback=True)
+    assert code == 200 and doc["rolled_back"]
+    code, doc = srv.reload(rollback=True)
+    assert code == 409
+
+
+def test_regressed_weights_chaos_fires_after_the_gate(tmp_path):
+    """deploy.regressed_weights scales the installed weights AFTER
+    load: the gate saw clean bytes, the served generation disagrees —
+    exactly the post-gate regression the watch must catch."""
+    _, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy).warmup()
+    snap_path = _solverstate(
+        tmp_path, "inc_iter_2.solverstate.npz", eng
+    )
+    clean = _tiny_engine(deploy).warmup()
+    clean.swap_from_file(snap_path)
+    chaos.install_from("deploy.regressed_weights@index=0:frac=64")
+    eng.swap_from_file(snap_path)
+    probe = _rows(16, seed=3)
+    clean_top1 = np.argmax(np.asarray(clean.infer(probe)), axis=-1)
+    hot_top1 = np.argmax(np.asarray(eng.infer(probe)), axis=-1)
+    assert not np.array_equal(clean_top1, hot_top1)
+
+
+def test_rollback_watch_fires_exactly_once_per_window():
+    t = [0.0]
+    w = RollbackWatch(window_s=10.0, regress_pct=2.0, now=lambda: t[0])
+    assert w.tick(probe_fn=None, burn_active=True) is None  # unarmed
+    w.arm(source="s", previous="p")
+    assert w.tick(probe_fn=None, burn_active=True) == "slo_burn"
+    # the double burn-fire: disarmed before reporting, so the second
+    # tick of the same window must NOT request a second rollback
+    assert w.tick(probe_fn=None, burn_active=True) is None
+    assert not w.armed and w.fired_reason == "slo_burn"
+
+    # surviving the window disarms with no reason (generation accepted)
+    w.arm(source="s2", previous="p")
+    t[0] += 11.0
+    assert w.tick(probe_fn=None, burn_active=True) is None
+    assert not w.armed and w.fired_reason is None
+
+    # live agreement regression past the bar fires; transient probe
+    # failures never do
+    w.arm(
+        source="s3", previous="p",
+        probe=np.zeros((4, 8), np.float32),
+        expected_top1=np.array([0, 1, 2, 3]),
+    )
+    assert w.tick(probe_fn=lambda p: None, burn_active=False) is None
+    assert w.probe_errors == 1
+    assert w.tick(
+        probe_fn=lambda p: np.array([0, 1, 2, 3]), burn_active=False
+    ) is None
+    reason = w.tick(
+        probe_fn=lambda p: np.array([3, 2, 1, 0]), burn_active=False
+    )
+    assert reason is not None and reason.startswith("agreement_regressed")
+    assert w.last_disagree_pct == 100.0
+
+
+# ----------------------------------------------------------- controller
+class _StubTier:
+    host, port = "127.0.0.1", 1  # never contacted (burn fires first)
+
+    def __init__(self):
+        self.rolled, self.rolled_back = [], []
+
+    def roll(self, weights):
+        self.rolled.append(weights)
+        return 200, {"rolled": [{"replica": 0}, {"replica": 1}]}
+
+    def roll_back(self, reason=""):
+        self.rolled_back.append(reason)
+        return 200, {"rolled_back": [{"replica": 0}, {"replica": 1}]}
+
+
+def test_controller_gates_rolls_and_rolls_back_once(tmp_path, monkeypatch):
+    train, deploy = _write_nets(tmp_path)
+    eng = _tiny_engine(deploy)
+    baseline = _solverstate(tmp_path, "boot_iter_0.solverstate.npz", eng)
+    tier = _StubTier()
+    ctl = DeployController(
+        tier,
+        deploy_dir=str(tmp_path / "dep"),
+        model=deploy,
+        train_net=train,
+        boot_weights=baseline,
+        window_s=60.0,
+        probe_n=4,
+        min_new_records=4,
+        run_trainer=False,
+    )
+    # seed the log (the probe source) and a candidate
+    tee = TeeWriter(ctl.log_dir, interval_s=60.0)
+    try:
+        for i in range(8):
+            tee.offer(_sample(i))
+        tee.flush()
+    finally:
+        tee.stop()
+    cand = os.path.join(ctl.candidate_dir, "inc_iter_4.solverstate.npz")
+    save_state(
+        cand,
+        params=jax.device_get(eng.params),
+        state=jax.device_get(eng.state),
+    )
+
+    assert ctl.tick() is None  # gate + roll + arm
+    assert tier.rolled == [cand]
+    assert ctl.watch.armed and ctl.rolls == 1
+    assert [e["action"] for e in ctl.events] == ["roll"]
+
+    monkeypatch.setattr(
+        "sparknet_tpu.telemetry.anomaly.active", lambda kind=None: ["p99"]
+    )
+    assert ctl.tick() == "slo_burn"  # burn inside the window
+    assert tier.rolled_back == ["slo_burn"]
+    assert ctl.rollbacks == 1 and ctl.last_rollback_ms is not None
+    # idempotent: the burn keeps burning, the tier rolls back ONCE
+    assert ctl.tick() is None
+    assert len(tier.rolled_back) == 1
+    # the rolled-back generation is ledger-ineligible: the controller
+    # will not re-gate it and the gate would refuse it anyway
+    ok, reason = gate.check_eligible(cand)
+    assert not ok and "ineligible" in reason
+    snap = ctl.snapshot()
+    assert snap["rollbacks"] == 1
+    assert [e["action"] for e in snap["events"]] == ["roll", "rollback"]
+    assert ctl.baseline == baseline  # never promoted to the bad gen
+
+
+# ------------------------------------------------- respawn generation re-sync
+def test_router_resyncs_respawned_replica_to_serving_generation():
+    """A replica respawned after a roll boots on its spawn-time argv
+    weights — the router must bring it onto the serving generation
+    BEFORE it becomes dispatchable again, or the tier serves mixed
+    generations until the next roll (and, post-rollback, could even
+    resurrect the exact weights the watch rolled back)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from sparknet_tpu.serve.router import Router
+
+    class _Stub:
+        def __init__(self):
+            self.generation = 0
+            self.weights_source = None
+            self.reloads = []
+            self.reload_status = 200
+            outer = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def log_message(self, *a):
+                    pass
+
+                def _reply(self, code, payload):
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    self._reply(200, {
+                        "status": "ok",
+                        "generation": outer.generation,
+                        "weights_source": outer.weights_source,
+                        "warmup_s": 0.1, "pid": None,
+                    })
+
+                def do_POST(self):
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    outer.reloads.append(req.get("weights"))
+                    if outer.reload_status != 200:
+                        self._reply(outer.reload_status,
+                                    {"error": "scripted"})
+                        return
+                    outer.generation += 1
+                    outer.weights_source = req.get("weights")
+                    self._reply(200, {
+                        "generation": outer.generation,
+                        "source": req.get("weights"),
+                    })
+
+            self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+            self.httpd.daemon_threads = True
+            self.host, self.port = self.httpd.server_address[:2]
+            threading.Thread(
+                target=self.httpd.serve_forever, daemon=True
+            ).start()
+
+        def stop(self):
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+    a, b = _Stub(), _Stub()
+    router = Router(
+        [(a.host, a.port), (b.host, b.port)],
+        model_name="stub", health_interval_s=30.0,
+    )
+    try:
+        router.health_tick()
+        code, doc = router.roll("/fake/w_iter_2.caffemodel")
+        assert code == 200, doc
+        assert router._serving_weights == "/fake/w_iter_2.caffemodel"
+
+        # simulate replica 0's respawn: fresh process on boot weights
+        a.generation, a.weights_source, a.reloads = 0, None, []
+        rep = router.replicas[0]
+        rep.healthy = False
+        rep.needs_resync = True
+
+        # resync failure (e.g. gate 409): stays OUT of dispatch
+        a.reload_status = 409
+        router.health_tick()
+        assert not rep.healthy and rep.needs_resync
+        assert a.reloads == ["/fake/w_iter_2.caffemodel"]
+
+        # resync success: reloaded onto the serving weights, THEN
+        # healthy — never dispatchable on the stale generation
+        a.reload_status = 200
+        router.health_tick()
+        assert rep.healthy and not rep.needs_resync
+        assert a.weights_source == "/fake/w_iter_2.caffemodel"
+        assert rep.generation == 1
+
+        # rollback retargets the resync at what the tier serves NOW —
+        # this stub restores boot weights (source None), so re-sync
+        # disarms entirely: a respawn boots on those same weights
+        code, doc = router.roll_back("agreement_regressed")
+        assert code == 200, doc
+        assert router._serving_weights is None
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
