@@ -429,6 +429,21 @@ else
   exit 1
 fi
 
+# ---- storage-fault smoke (ISSUE 19): the same closed-loop tier rides
+# out a seeded volume-wide ENOSPC storm hitting the tee in every
+# replica plus a one-shot ENOSPC on the trainer's candidate snapshot —
+# zero failed requests, zero trainer give-ups/respawns, the tee pauses
+# (counted drops) and RESUMES sealing once the storm clears, the
+# skipped snapshot never stalls the roll loop (2 gated rolls), the
+# post-storm tier answers bit-exact vs the pinned baseline, and the
+# tee log decodes end to end with no bare staging files left behind.
+if timeout -k 10 580 env JAX_PLATFORMS=cpu python scripts/storage_smoke.py; then
+  echo "check.sh: storage smoke OK (ENOSPC storm -> tee pause/resume + snapshot skip, 0 failed, bit-exact)"
+else
+  echo "check.sh: storage SMOKE FAILED"
+  exit 1
+fi
+
 # ---- quant smoke (ISSUE 12): an int8 1-replica tier hot-swaps a
 # manifest-verified snapshot (scales re-captured at swap time), the
 # quant tag rides /healthz and /classify next to gen, f32-vs-int8

@@ -1,0 +1,460 @@
+#!/usr/bin/env python
+"""Storage-fault smoke (ISSUE 19, run by scripts/check.sh).
+
+A live serving tier with the closed-loop trainer rides out a seeded
+disk-fault plan that hits every writer class at once, and degrades
+instead of failing:
+
+1. boot a 2-replica router tier with ``--deploy-dir`` (traffic tee +
+   supervised incremental trainer + eval gate) on a tiny 8-feature
+   MLP, gate enforcement ON, and a chaos plan that (a) opens a
+   volume-wide ENOSPC *storm* in each replica at its second tee-shard
+   seal (``io.enospc_storm@site=tee``) and (b) fails the trainer's
+   second candidate snapshot with ENOSPC (``io.enospc@site=snapshot``);
+2. drive closed-loop traffic the entire time — through the storm the
+   tee seals fail, the writer is quarantined, offers are dropped and
+   counted, and the tee PAUSES (never throws into the serve path);
+3. assert the degradation contract: ZERO failed requests, ZERO
+   trainer give-ups or respawns (the skipped snapshot never crashed
+   it), the tee RESUMES sealing once the storm clears (written grows
+   past its at-fault watermark, ``io_paused`` back to False), the
+   loop keeps rolling candidates after the skip (rolls >= 2), and the
+   shm decoded-batch cache — driven in-process through the same storm
+   shape — disables itself with clean misses instead of raising;
+4. assert post-storm serving is bit-exact against the pinned baseline
+   generation (an offline engine restored from the same solverstate
+   answers identically), and the tee log is readable end to end —
+   every surviving shard decodes, no bare ``*.writing`` staging file
+   remains (torn shards are ``.writing.quarantined``).
+
+Exit 0 on success; any assertion prints the evidence and exits 1.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+TRAIN_NET = """
+name: "tiny"
+layer { name: "d" type: "Input" top: "data" top: "label" }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2"
+        bottom: "label" top: "loss" }
+"""
+
+DEPLOY_NET = """
+name: "tiny"
+input: "data"
+input_shape { dim: 1 dim: 8 }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16
+          weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 4
+          weight_filler { type: "gaussian" std: 0.5 } } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+# (a) each replica's SECOND tee seal opens a 1.5 s process-local
+#     volume-wide ENOSPC storm (every site in that replica refuses
+#     writes until it clears);
+# (b) the trainer's SECOND candidate snapshot hits a one-shot ENOSPC
+#     (prune finds nothing to free on a young chain -> counted skip).
+CHAOS = (
+    "io.enospc_storm@site=tee:after=1:times=1:clear_after_s=1.5,"
+    "io.enospc@site=snapshot:index=1"
+)
+
+
+def wait_for(pred, timeout_s, what, debug=None):
+    deadline = time.time() + timeout_s
+    next_debug = time.time() + 15.0
+    while time.time() < deadline:
+        got = pred()
+        if got:
+            return got
+        if debug is not None and time.time() >= next_debug:
+            next_debug = time.time() + 15.0
+            try:
+                print(f"... waiting for {what}: {debug()}", flush=True)
+            except Exception:
+                pass
+        time.sleep(0.3)
+    raise SystemExit(f"storage smoke: timed out waiting for {what}")
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    tmp = tempfile.mkdtemp(prefix="storage_smoke_")
+    deploy_dir = os.path.join(tmp, "deploy")
+    portfile = os.path.join(tmp, "router.json")
+    log = open(os.path.join(tmp, "tier.log"), "w")
+    train_net = os.path.join(tmp, "train.prototxt")
+    deploy_net = os.path.join(tmp, "deploy.prototxt")
+    with open(train_net, "w") as fh:
+        fh.write(TRAIN_NET)
+    with open(deploy_net, "w") as fh:
+        fh.write(DEPLOY_NET)
+
+    import numpy as np
+
+    import jax
+    from sparknet_tpu.serve.engine import InferenceEngine
+    from sparknet_tpu.solver import snapshot as snap
+
+    eng = InferenceEngine.from_files(deploy_net, buckets=(8,))
+    boot = os.path.join(tmp, "boot_iter_1.solverstate.npz")
+    snap.save_state(
+        boot,
+        params=jax.device_get(eng.params),
+        state=jax.device_get(eng.state),
+    )
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SPARKNET_DEPLOY_GATE": "require",
+        "SPARKNET_CHAOS": CHAOS,
+        "SPARKNET_DEPLOY_WATCH_S": "2.5",
+        "SPARKNET_DEPLOY_PROBE_N": "8",
+        "SPARKNET_DEPLOY_MIN_NEW": "8",
+        # consecutive candidates are a few SGD steps apart; the gate
+        # bar is relaxed like closed_loop_smoke — this run is about
+        # storage faults, not watch regressions
+        "SPARKNET_DEPLOY_DISAGREE_PCT": "75",
+        "SPARKNET_DEPLOY_REGRESS_PCT": "90",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sparknet_tpu.tools.serve",
+         "--model", deploy_net, "--weights", boot,
+         "--replicas", "2", "--port", "0", "--buckets", "1,8",
+         "--portfile", portfile,
+         "--run-dir", os.path.join(tmp, "run"),
+         "--deploy-dir", deploy_dir,
+         "--deploy-train-net", train_net,
+         "--deploy-interval-s", "0.25"],
+        cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    stop = threading.Event()
+    try:
+        wait_for(
+            lambda: os.path.exists(portfile) or proc.poll() is not None,
+            300, "router portfile",
+        )
+        if proc.poll() is not None:
+            print(open(log.name).read()[-4000:])
+            raise SystemExit("storage smoke: tier died at boot")
+        doc = json.load(open(portfile))
+
+        from sparknet_tpu.serve.server import Client
+
+        client = Client(doc["host"], doc["port"], timeout=60, retries=4)
+
+        def healthz():
+            try:
+                _, hz = client.healthz()
+                return hz
+            except Exception:
+                return None
+
+        wait_for(
+            lambda: (lambda hz: hz if hz
+                     and hz.get("replicas_healthy") == 2 else None)(
+                healthz()
+            ),
+            300, "2 healthy replicas",
+        )
+
+        # ---- continuous traffic; the failure counter runs across the
+        # whole storm
+        stats = {"requests": 0, "failed": 0}
+        lock = threading.Lock()
+
+        def drive(seed):
+            rng = np.random.default_rng(seed)
+            c = Client(doc["host"], doc["port"], timeout=60, retries=4)
+            while not stop.is_set():
+                rows = rng.normal(size=(8, 8)).astype(np.float32)
+                try:
+                    st, _ = c.classify(rows, top_k=1)
+                except Exception:
+                    st = 599
+                with lock:
+                    if st == 200:
+                        stats["requests"] += 1
+                    else:
+                        stats["failed"] += 1
+
+        threads = [
+            threading.Thread(target=drive, args=(s,), daemon=True)
+            for s in range(3)
+        ]
+        for t in threads:
+            t.start()
+
+        def tee_totals():
+            hz = healthz()
+            if not hz:
+                return None
+            tees = [
+                (r.get("tee") or {}) for r in hz.get("replicas", [])
+            ]
+            if not tees:
+                return None
+            return {
+                "written": sum(t.get("written", 0) for t in tees),
+                "dropped": sum(t.get("dropped", 0) for t in tees),
+                "shards": sum(t.get("shards", 0) for t in tees),
+                "paused": [bool(t.get("io_paused")) for t in tees],
+            }
+
+        def tee_debug():
+            return json.dumps(tee_totals())
+
+        # ---- phase 1: the storm hits — seals fail, offers drop, the
+        # tee pauses instead of throwing into the serve path
+        t0 = time.time()
+        hit = wait_for(
+            lambda: (lambda t: t if t and t["dropped"] > 0 else None)(
+                tee_totals()
+            ),
+            300, "ENOSPC storm to hit a tee seal (dropped > 0)",
+            debug=tee_debug,
+        )
+        written_at_fault = hit["written"]
+        print(
+            f"storage smoke: storm hit after {time.time() - t0:.1f}s "
+            f"({hit})", flush=True,
+        )
+
+        # ---- phase 2: the storm clears and the tee RESUMES sealing —
+        # written grows past the at-fault watermark and no replica is
+        # still paused
+        resumed = wait_for(
+            lambda: (lambda t: t if t
+                     and t["written"] > written_at_fault
+                     and not any(t["paused"]) else None)(tee_totals()),
+            300, "tee to resume sealing after the storm",
+            debug=tee_debug,
+        )
+        print(f"storage smoke: tee resumed ({resumed})", flush=True)
+
+        # ---- phase 3: the trainer's skipped snapshot — counted, never
+        # fatal — and the loop keeps rolling candidates past it
+        wait_for(
+            lambda: "skipped (enospc" in open(log.name).read(),
+            300, "trainer snapshot skip warning (enospc)",
+        )
+
+        def deploy_block():
+            hz = healthz()
+            return hz.get("deploy") if hz else None
+
+        def dep_debug():
+            d = deploy_block() or {}
+            return json.dumps({
+                "rolls": d.get("rolls"),
+                "last_gated_iter": d.get("last_gated_iter"),
+                "trainer": d.get("trainer"),
+            }, default=str)
+
+        dep = wait_for(
+            lambda: (lambda d: d if d and d.get("rolls", 0) >= 2 else None)(
+                deploy_block()
+            ),
+            300, "2 gated rolls (the loop outlives the skipped snapshot)",
+            debug=dep_debug,
+        )
+        stop.set()
+        for t in threads:
+            t.join(60)
+
+        # the loop keeps rolling while the trainer drains the tee
+        # backlog traffic left behind, and during a watch window the
+        # tier serves the WATCHED candidate, not the baseline — wait
+        # for quiescence (no new roll, watch disarmed, three stable
+        # polls) so "baseline" below really is the serving generation
+        last_sig, streak = object(), 0
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            d = deploy_block()
+            armed = bool(((d or {}).get("watch") or {}).get("armed"))
+            sig = d and (
+                d.get("rolls"), d.get("last_gated_iter"),
+                d.get("baseline"),
+            )
+            if d is not None and not armed and sig == last_sig:
+                streak += 1
+                if streak >= 3:
+                    dep = d
+                    break
+            else:
+                streak, last_sig = 0, sig
+            time.sleep(1.0)
+        else:
+            raise SystemExit(
+                "storage smoke: deploy loop never quiesced after "
+                "traffic stopped"
+            )
+
+        # ---- degradation contract: zero failed requests, zero
+        # give-ups, zero trainer respawns
+        with lock:
+            failed, requests = stats["failed"], stats["requests"]
+        assert requests > 0, "traffic driver never completed a request"
+        assert failed == 0, (
+            f"failed requests during the ENOSPC storm: {failed}"
+        )
+        trainer = dep.get("trainer") or {}
+        children = trainer.get("children") or []
+        assert children and trainer.get("alive") == len(children), (
+            f"trainer pool not fully alive: {trainer}"
+        )
+        give_ups = [
+            c for c in children if c.get("give_up_reason")
+        ]
+        assert not give_ups, f"trainer gave up: {give_ups}"
+        respawned = [c for c in children if c.get("spawns", 1) > 1]
+        assert not respawned, (
+            f"the skipped snapshot crashed the trainer (respawns): "
+            f"{respawned}"
+        )
+        hz = healthz() or {}
+        assert hz.get("replicas_healthy") == 2, (
+            f"replicas unhealthy after the storm: {hz}"
+        )
+
+        # ---- the third writer class: the shm decoded-batch cache
+        # under the same storm shape (in-process — serving replicas
+        # attach the cache readonly, so the parent drives a writable
+        # one through the identical fault plan)
+        from sparknet_tpu import chaos
+        from sparknet_tpu.data.cache import ShmBatchCache
+        from sparknet_tpu.utils import safeio
+
+        cache = ShmBatchCache(
+            f"storage-smoke-{os.getpid()}",
+            registry_dir=os.path.join(tmp, "cachereg"),
+            max_bytes=1 << 20,
+        )
+        try:
+            batch = {"x": np.arange(16, dtype=np.float32)}
+            assert cache.put("warm", batch), "pre-storm cache put failed"
+            chaos.install(
+                "io.enospc_storm@site=cache:times=1:clear_after_s=0.3"
+            )
+            # the storm outlives the evict+retry leg: the put must
+            # degrade (disable-with-counter), never raise
+            assert not cache.put("stormy", batch), (
+                "cache put claimed success inside an ENOSPC storm"
+            )
+            assert cache._io_disabled, "cache not disabled by the storm"
+            assert cache.get("warm") is None, (
+                "post-shed get must be a clean miss, not an error"
+            )
+        finally:
+            chaos.clear()
+            safeio.reset()
+            cache.clear()
+
+        # ---- post-storm serving is bit-exact against the pinned
+        # baseline generation
+        base = dep.get("baseline") or ""
+        cand = os.path.join(deploy_dir, "candidates", base)
+        if not os.path.exists(cand) and os.path.basename(boot) == base:
+            cand = boot
+        assert os.path.exists(cand), (
+            f"baseline solverstate {base!r} not found under "
+            f"{deploy_dir}/candidates"
+        )
+        ref = InferenceEngine.from_files(deploy_net, cand, buckets=(8,))
+        probe = np.random.default_rng(123).normal(size=(8, 8)).astype(
+            np.float32
+        )
+        want = np.argmax(np.asarray(ref.infer(probe)), axis=-1)
+        st, resp = client.classify(probe, top_k=1)
+        assert st == 200, f"post-storm classify failed: {resp}"
+        got = np.asarray([r[0] for r in resp["indices"]])
+        diverged = int(np.sum(got != want))
+        assert diverged == 0, (
+            f"{diverged}/8 post-storm answers disagree with the "
+            f"baseline generation {base}"
+        )
+    except BaseException:
+        stop.set()
+        try:
+            sys.stdout.write(open(log.name).read()[-4000:])
+        except Exception:
+            pass
+        raise
+    finally:
+        stop.set()
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        log.close()
+
+    # ---- post-mortem (tier down): the tee log is readable end to end
+    # and no bare staging file survived the storm
+    try:
+        from sparknet_tpu.data.records import PackedDataset
+
+        log_dir = os.path.join(deploy_dir, "log")
+        ds = PackedDataset(log_dir)
+        n = 0
+        for i in range(ds.num_partitions):
+            part = ds.collect_partition(i)
+            n += int(next(iter(part.values())).shape[0])
+        assert n == ds.num_records and n > 0, (
+            f"tee log decode mismatch: read {n}, manifest says "
+            f"{ds.num_records}"
+        )
+        torn = [
+            p for p in glob.glob(os.path.join(log_dir, "*"))
+            if p.endswith(".writing") or ".tmp" in os.path.basename(p)
+        ]
+        assert not torn, f"bare staging files survived the storm: {torn}"
+        quarantined = glob.glob(
+            os.path.join(log_dir, "*.writing.quarantined")
+        )
+        print(
+            "storage smoke: OK — 0 failed requests across "
+            f"{stats['requests']} reqs through a volume-wide ENOSPC "
+            f"storm, tee dropped {resumed['dropped']} and resumed "
+            f"({resumed['written']} records sealed, {n} readable, "
+            f"{len(quarantined)} quarantined shard(s)), trainer skipped "
+            f"a snapshot without a respawn, 0 give-ups, "
+            f"{dep.get('rolls')} gated rolls, shm cache degraded to "
+            f"clean misses, post-storm answers bit-exact vs baseline"
+        )
+        return 0
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

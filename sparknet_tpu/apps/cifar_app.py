@@ -467,10 +467,18 @@ def train_loop(
         )
         with tl.phase("snapshot"):
             # collective (gathers host-sharded optimizer slots); every
-            # process participates, only process 0 writes the files
-            solver.save(state_path)
-            if multihost.is_primary():
-                W.save_npz(path, solver.params)
+            # process participates, only process 0 writes the files.
+            # Disk-full degrades to skip-with-counter (prune+retry
+            # first) instead of crashing training — the prior chain
+            # stays the bit-exact resume point (docs/ROBUSTNESS.md)
+            saved = solver.save_or_skip(state_path, prefix=sp.snapshot_prefix)
+            if multihost.is_primary() and saved:
+                try:
+                    W.save_npz(path, solver.params)
+                except OSError as e:
+                    from ..utils import safeio
+
+                    safeio.count_fault("snapshot", safeio.classify(e))
                 # keep-last-k (SPARKNET_SNAPSHOT_KEEP): bounds disk
                 # growth while leaving older snapshots for torn-file
                 # fallback

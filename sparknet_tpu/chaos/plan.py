@@ -11,7 +11,9 @@ and each ``cond`` is ``key=value``.  Keys fall into three groups:
 - **coordinates** (``batch=37``, ``worker=1``, ``request=12``,
   ``iter=500``, ``tick=3``, ``index=0``): exact-match predicates
   against the coordinates the injection site passes.  A clause fires
-  only when every coordinate it names matches.
+  only when every coordinate it names matches.  ``site=snapshot`` is
+  the one *string* coordinate — the writer tag the ``io.*`` points
+  (utils/safeio.py) target.
 - **schedule predicates**: ``p=0.25`` (seeded Bernoulli per index),
   ``every=2`` (index % every == 0), ``after=10`` (index >= after),
   ``times=3`` (at most N fires per process), ``seed=7`` (per-clause
@@ -109,15 +111,46 @@ FAULT_POINTS: Dict[str, str] = {
         "coords: index (per-process swap_from_file count); params: "
         "frac (scale factor, default 8.0)"
     ),
+    "io.enospc": (
+        "a writer's atomic publish (utils/safeio.py) fails with ENOSPC "
+        "(disk full) before any byte lands; coords: site (writer tag: "
+        "snapshot/tee/cache/compile_cache/records/flight/ledger), "
+        "index (per-site write count)"
+    ),
+    "io.eio": (
+        "a writer's atomic publish fails with EIO (media error); "
+        "coords: site (writer tag), index (per-site write count)"
+    ),
+    "io.slow_write": (
+        "a writer's atomic publish stalls before writing (degraded "
+        "disk); coords: site (writer tag), index (per-site write "
+        "count); params: delay_ms (default 50)"
+    ),
+    "io.enospc_storm": (
+        "volume-wide disk-full window: the matched write AND every "
+        "subsequent write at every site fails ENOSPC until the storm "
+        "clears; coords: site (writer tag), index (per-site write "
+        "count); params: clear_after_s (default 2)"
+    ),
 }
 
 # which coordinate serves as the schedule index, in priority order
 _INDEX_COORDS = ("batch", "request", "iter", "tick", "index")
 _SCHEDULE_KEYS = {"p", "every", "after", "times", "seed"}
-_PARAM_KEYS = {"delay_ms", "exit_code", "frac"}
+_PARAM_KEYS = {"delay_ms", "exit_code", "frac", "clear_after_s"}
+# coordinates whose values are identifiers, not sequence numbers (the
+# io.* writer-site tags)
+_STR_COORDS = {"site"}
 
 
 def _parse_value(point: str, key: str, raw: str):
+    if key in _STR_COORDS:
+        if not raw or not raw.replace("_", "").isalnum():
+            raise ValueError(
+                f"chaos spec: {point}@{key}={raw!r} — value must be a "
+                f"writer site tag (identifier)"
+            )
+        return raw
     try:
         return int(raw)
     except ValueError:
@@ -138,7 +171,7 @@ class Rule:
 
     def __init__(self, point: str, conds: Dict[str, float]):
         self.point = point
-        self.match: Dict[str, int] = {}
+        self.match: Dict[str, object] = {}
         self.p: Optional[float] = None
         self.every: Optional[int] = None
         self.after: Optional[int] = None
@@ -168,8 +201,9 @@ class Rule:
             elif k == "seed":
                 self.seed = int(v)
             else:
-                # anything else is an exact coordinate match
-                self.match[k] = int(v)
+                # anything else is an exact coordinate match (site tags
+                # stay strings; every other coordinate is an integer)
+                self.match[k] = v if isinstance(v, str) else int(v)
 
     def _index(self, coords: Dict[str, int]) -> Optional[int]:
         for k in _INDEX_COORDS:

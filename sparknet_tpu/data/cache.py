@@ -192,6 +192,12 @@ class ShmBatchCache:
         self.registry_dir = os.path.join(base, self._ns)
         os.makedirs(self.registry_dir, exist_ok=True)
         self.metrics = metrics or CacheMetrics()
+        # storage-fault degradation (docs/ROBUSTNESS.md): an ENOSPC on
+        # /dev/shm evicts every unpinned entry and retries the put ONCE;
+        # a second failure (or any other I/O error) disables puts for
+        # the rest of this process — the cache degrades to a pure miss
+        # path, it never degrades the job
+        self._io_disabled = False
 
     # ------------------------------------------------------------ naming
     def _seg_name(self, key: str) -> str:
@@ -309,7 +315,7 @@ class ShmBatchCache:
         present, raced, larger than the whole budget, or the cache is
         attached ``readonly``) — callers never depend on a put
         landing."""
-        if self.readonly:
+        if self.readonly or self._io_disabled:
             self.metrics.record("put_skipped")
             return False
         metas: List[Tuple[str, str, tuple, int]] = []
@@ -327,48 +333,117 @@ class ShmBatchCache:
             self.metrics.record("put_skipped")
             return False
         seg = self._seg_name(key)
+        from ..utils import safeio
+
         with self._locked():
             if os.path.exists(self._keyfile(seg)):
                 return False
             self._evict_for(size)
             try:
-                shm = shared_memory.SharedMemory(
-                    name=seg, create=True, size=size
-                )
+                self._publish(seg, key, size, meta_json, payload_len,
+                              metas, arrs)
             except FileExistsError:
                 return False  # another job won the race
-            _untrack(shm)
-            try:
-                # incomplete header first; readers skip it until the
-                # final header lands with the CRC + complete flag
-                _HDR.pack_into(
-                    shm.buf, 0, _MAGIC, _VERSION, 0, len(meta_json),
-                    payload_len, 0,
-                )
-                shm.buf[_HDR.size : _HDR.size + len(meta_json)] = meta_json
-                payload_off = _HDR.size + len(meta_json)
-                dst = None
-                for (k, dt, shape, arr_off) in metas:
-                    a = arrs[k]
-                    dst = np.ndarray(
-                        shape, np.dtype(dt), buffer=shm.buf,
-                        offset=payload_off + arr_off,
-                    )
-                    dst[...] = a
-                del dst  # a live view makes shm.close() raise
-                crc = checksum_region(
-                    shm.buf[payload_off : payload_off + payload_len]
-                )
-                _HDR.pack_into(
-                    shm.buf, 0, _MAGIC, _VERSION, _COMPLETE, len(meta_json),
-                    payload_len, crc,
-                )
-                with open(self._keyfile(seg), "w") as fh:
-                    json.dump({"key": key, "bytes": size}, fh)
-            finally:
-                shm.close()
+            except OSError as e:
+                kind = safeio.classify(e)
+                safeio.count_fault("cache", kind)
+                if kind == "enospc":
+                    # /dev/shm is full: the byte budget is moot — shed
+                    # every unpinned entry and retry exactly once
+                    self._evict_unpinned()
+                    try:
+                        self._publish(seg, key, size, meta_json,
+                                      payload_len, metas, arrs)
+                    except FileExistsError:
+                        return False
+                    except OSError as e2:
+                        safeio.count_fault("cache", safeio.classify(e2))
+                        self._disable_io(e2)
+                        return False
+                    else:
+                        self.metrics.record("put", bytes_=size)
+                        return True
+                self._disable_io(e)
+                return False
         self.metrics.record("put", bytes_=size)
         return True
+
+    def _publish(
+        self, seg: str, key: str, size: int, meta_json: bytes,
+        payload_len: int, metas, arrs,
+    ) -> None:
+        """One publication attempt (caller holds the namespace lock).
+        Raises FileExistsError on a lost race, OSError on storage
+        faults; a half-written segment never survives a failure."""
+        from ..utils import safeio
+
+        safeio.check_faults("cache")
+        shm = shared_memory.SharedMemory(name=seg, create=True, size=size)
+        _untrack(shm)
+        try:
+            # incomplete header first; readers skip it until the
+            # final header lands with the CRC + complete flag
+            _HDR.pack_into(
+                shm.buf, 0, _MAGIC, _VERSION, 0, len(meta_json),
+                payload_len, 0,
+            )
+            shm.buf[_HDR.size : _HDR.size + len(meta_json)] = meta_json
+            payload_off = _HDR.size + len(meta_json)
+            dst = None
+            for (k, dt, shape, arr_off) in metas:
+                a = arrs[k]
+                dst = np.ndarray(
+                    shape, np.dtype(dt), buffer=shm.buf,
+                    offset=payload_off + arr_off,
+                )
+                dst[...] = a
+            del dst  # a live view makes shm.close() raise
+            crc = checksum_region(
+                shm.buf[payload_off : payload_off + payload_len]
+            )
+            _HDR.pack_into(
+                shm.buf, 0, _MAGIC, _VERSION, _COMPLETE, len(meta_json),
+                payload_len, crc,
+            )
+            with open(self._keyfile(seg), "w") as fh:
+                json.dump({"key": key, "bytes": size}, fh)
+        except OSError:
+            _unlink(shm)  # a corpse here would be read as torn forever
+            try:
+                os.remove(self._keyfile(seg))
+            except OSError:
+                pass
+            raise
+        finally:
+            shm.close()
+
+    def _evict_unpinned(self) -> int:
+        """Emergency shed (ENOSPC retry path): unlink every unpinned
+        entry regardless of budget.  Caller holds the namespace lock."""
+        n = 0
+        for _, seg, _ in sorted(self._entries()):
+            if self._pinned(seg):
+                continue
+            self._unlink_entry(seg)
+            self.metrics.record("evict")
+            n += 1
+        return n
+
+    def _disable_io(self, err: OSError) -> None:
+        """Stop publishing for the rest of this process: every future
+        put is a counted skip — jobs keep working, correctness holds."""
+        import sys
+
+        self._io_disabled = True
+        self.metrics.record("put_skipped")
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.counter("data_cache", event="io_disabled").inc()
+        print(
+            f"WARNING: data cache [{self.namespace}]: puts disabled "
+            f"after storage fault: {err}",
+            file=sys.stderr, flush=True,
+        )
 
     # ---------------------------------------------------------- eviction
     def _entries(self) -> List[Tuple[float, str, int]]:

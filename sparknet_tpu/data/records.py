@@ -401,10 +401,12 @@ def pack_dataset(
         manifest["meta"] = meta
     if mean is not None:
         np.save(os.path.join(out_dir, MEAN_NAME), np.asarray(mean, np.float32))
-    tmp = os.path.join(out_dir, MANIFEST_NAME + ".tmp")
-    with open(tmp, "w") as fh:
-        json.dump(manifest, fh, indent=1)
-    os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))
+    from ..utils import safeio
+
+    safeio.atomic_write_json(
+        os.path.join(out_dir, MANIFEST_NAME), manifest, site="records",
+        fsync=False,
+    )
     return manifest
 
 
@@ -456,6 +458,7 @@ def write_manifest(
     fields: Dict[str, Any],
     *,
     meta: Optional[Dict[str, Any]] = None,
+    site: str = "records",
 ) -> Dict[str, Any]:
     """Atomically (tmp + rename) publish ``MANIFEST.json`` over a set
     of finished shard dicts.  Readers opening the split mid-rewrite see
@@ -472,13 +475,15 @@ def write_manifest(
     }
     if meta:
         manifest["meta"] = meta
-    # pid-unique tmp name: concurrent publishers (one tee writer per
-    # replica process over a shared log) must not clobber each other's
-    # tmp between write and rename
-    tmp = os.path.join(out_dir, f"{MANIFEST_NAME}.{os.getpid()}.tmp")
-    with open(tmp, "w") as fh:
-        json.dump(manifest, fh, indent=1)
-    os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))
+    # safeio stages to a pid-unique tmp: concurrent publishers (one tee
+    # writer per replica process over a shared log) must not clobber
+    # each other's tmp between write and rename
+    from ..utils import safeio
+
+    safeio.atomic_write_json(
+        os.path.join(out_dir, MANIFEST_NAME), manifest, site=site,
+        fsync=False,
+    )
     return manifest
 
 

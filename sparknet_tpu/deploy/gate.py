@@ -99,11 +99,17 @@ def read_verdict(snapshot: str) -> Optional[Dict[str, Any]]:
         return None
 
 
-def _write_json(path: str, doc: Dict[str, Any]) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(doc, fh, indent=1, default=str)
-    os.replace(tmp, path)
+def _write_json(path: str, doc: Dict[str, Any]) -> bool:
+    """Atomic, best-effort verdict/ledger write (safeio site
+    ``ledger``): a full disk must not crash the gate/controller loop —
+    an unwritten verdict leaves the candidate ungated, which fails
+    CLOSED at enforcement (swap refuses ungated snapshots), and the
+    failure is counted in ``io_faults{site=ledger}``."""
+    from ..utils import safeio
+
+    return safeio.best_effort_write_json(
+        path, doc, site="ledger", default=str, fsync=False
+    )
 
 
 # ------------------------------------------------- ineligibility ledger
@@ -305,14 +311,25 @@ def evaluate(
         )
     verdict["verdict"] = "pass"
     verdict["reason"] = "ok"
-    np.savez(
-        candidate + PROBE_SUFFIX + ".tmp.npz",
-        probe=np.asarray(probe),
-        expected_top1=cand_top1.astype(np.int64),
-    )
-    os.replace(
-        candidate + PROBE_SUFFIX + ".tmp.npz", candidate + PROBE_SUFFIX
-    )
+
+    def _probe_payload(fh):
+        np.savez(
+            fh,
+            probe=np.asarray(probe),
+            expected_top1=cand_top1.astype(np.int64),
+        )
+
+    from ..utils import safeio
+
+    try:
+        safeio.atomic_write(
+            candidate + PROBE_SUFFIX, _probe_payload, site="ledger",
+            fsync=False,
+        )
+    except OSError:
+        # counted in io_faults{site=ledger}; the post-roll watch just
+        # skips probe replay for this generation (load_probe -> None)
+        pass
     _write_json(verdict_path(candidate), verdict)
     REGISTRY.counter("deploy_events", action="gate_pass").inc()
     return verdict

@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -28,8 +29,13 @@ import numpy as np
 
 from ..data import records as rec
 from ..telemetry.registry import REGISTRY
+from ..utils import safeio
 
 QUARANTINE_SUFFIX = ".quarantined"
+# the trainer's consumed-resume floor (records), published best-effort
+# into the log dir after each incremental round; the retention policy
+# (SPARKNET_DEPLOY_LOG_MB) only ever evicts shards wholly below it
+CONSUMED_NAME = "CONSUMED.json"
 # in-progress shards live under this suffix (full name
 # ``shard-<pid>-<k>-00000.snpk.writing``) and are renamed to ``.snpk``
 # only when finished — so every ``.snpk`` a reader can see is either
@@ -166,6 +172,19 @@ class TeeWriter:
         self._c_offer = REGISTRY.counter("deploy_tee", event="offer")
         self._c_drop = REGISTRY.counter("deploy_tee", event="drop")
         self._c_shard = REGISTRY.counter("deploy_tee", event="shard")
+        self._c_io = REGISTRY.counter("deploy_tee", event="io_error")
+        self._c_evict = REGISTRY.counter("deploy_tee", event="evict_shard")
+        # io-fault degradation state (docs/ROBUSTNESS.md): a disk that
+        # says no pauses the drain with doubling backoff; samples keep
+        # flowing into the bounded buffer and overflow into the normal
+        # drop-and-count path, never into the request path or the
+        # drain thread's stack
+        self._paused_until = 0.0
+        self._io_paused = False
+        self._io_backoff_s = 0.25
+        self._log_budget_mb = float(
+            os.environ.get("SPARKNET_DEPLOY_LOG_MB", "0") or 0
+        )
         summary = recover_log(out_dir)
         self._io_lock = threading.Lock()
         self._shards: List[Dict[str, Any]] = self._manifest_shards()
@@ -233,10 +252,15 @@ class TeeWriter:
             self._drain()
         self._drain()
         with self._io_lock:
-            self._seal_shard()
+            try:
+                self._seal_shard()
+            except OSError as e:
+                self._io_pause(e)
 
     def _drain(self) -> None:
         with self._io_lock:
+            if self._paused_until and time.monotonic() < self._paused_until:
+                return  # io backoff: let the buffer absorb the burst
             while self._buf:
                 sample = self._buf.popleft()
                 if self._writer is None:
@@ -245,13 +269,24 @@ class TeeWriter:
                         f"shard-{self._writer_id}-{self._seq:05d}"
                         f"{rec.SHARD_SUFFIX}{WRITING_SUFFIX}",
                     )
-                    self._writer = rec.ShardWriter(path)
+                    try:
+                        safeio.check_faults("tee")
+                        self._writer = rec.ShardWriter(path)
+                    except OSError as e:
+                        self._io_pause(e, lost=1)
+                        return
                     self._writer_n = 0
                     self._seq += 1
                 try:
                     self._writer.add(
                         {k: np.asarray(v) for k, v in sample.items()}
                     )
+                except OSError as e:
+                    # the shard tail may hold a partial record: abandon
+                    # it (quarantined, never manifested) and back off
+                    self._abandon_writer()
+                    self._io_pause(e, lost=1)
+                    return
                 except Exception:
                     REGISTRY.counter("deploy_tee", event="encode_error").inc()
                     continue
@@ -264,15 +299,52 @@ class TeeWriter:
                 self._writer_n += 1
                 self.written += 1
                 if self._writer_n >= self.shard_records:
-                    self._seal_shard()
+                    try:
+                        self._seal_shard()
+                    except OSError as e:
+                        self._io_pause(e)
+                        return
+
+    def _io_pause(self, err: OSError, lost: int = 0) -> None:
+        """One io fault on the drain thread: count it, optionally count
+        the sample it took down as a drop, and pause the drain with
+        doubling backoff (reset by the next successful seal)."""
+        safeio.count_fault("tee", safeio.classify(err))
+        self._c_io.inc()
+        for _ in range(lost):
+            self.dropped += 1
+            self._c_drop.inc()
+        self._paused_until = time.monotonic() + self._io_backoff_s
+        self._io_backoff_s = min(self._io_backoff_s * 2.0, 5.0)
+        self._io_paused = True
+
+    def _abandon_writer(self) -> None:
+        w, self._writer = self._writer, None
+        self._writer_n = 0
+        if w is None:
+            return
+        try:
+            w._f.close()
+        except Exception:
+            pass
+        try:
+            os.replace(w.path, w.path + QUARANTINE_SUFFIX)
+            REGISTRY.counter("deploy_tee", event="quarantine_torn").inc()
+        except OSError:
+            pass  # best effort: recover_log sweeps it once we're gone
 
     def _seal_shard(self) -> None:
         if self._writer is None or self._writer_n == 0:
             return
-        stats = self._writer.finish()
-        # publish the finished bytes under the reader-visible name
-        final = self._writer.path[: -len(WRITING_SUFFIX)]
-        os.replace(self._writer.path, final)
+        try:
+            safeio.check_faults("tee")
+            stats = self._writer.finish()
+            # publish the finished bytes under the reader-visible name
+            final = self._writer.path[: -len(WRITING_SUFFIX)]
+            os.replace(self._writer.path, final)
+        except OSError:
+            self._abandon_writer()
+            raise
         stats["file"] = os.path.basename(final)
         self._shards.append(stats)
         self._writer = None
@@ -288,10 +360,71 @@ class TeeWriter:
         known = {s["file"] for s in merged}
         merged.extend(s for s in self._shards if s["file"] not in known)
         self._shards = merged
+        self._apply_retention()
         rec.write_manifest(
             self.out_dir, self._shards, self._fields,
-            meta=self._meta or None,
+            meta=self._meta or None, site="tee",
         )
+        if self._io_paused:
+            # sealing works again: space came back — resume cleanly
+            self._io_paused = False
+            self._io_backoff_s = 0.25
+            self._paused_until = 0.0
+            from .. import chaos
+
+            chaos.record_recovery("deploy.tee_resume")
+
+    # ------------------------------------------------- retention
+
+    def _consumed_floor(self) -> int:
+        """Records the incremental trainer has durably consumed (its
+        published resume floor); 0 — evict nothing — when the trainer
+        hasn't published or the file is unreadable."""
+        import json
+
+        try:
+            with open(os.path.join(self.out_dir, CONSUMED_NAME)) as fh:
+                return max(0, int(json.load(fh).get("records", 0)))
+        except (OSError, ValueError, TypeError):
+            return 0
+
+    def _apply_retention(self) -> None:
+        """Bounded-log eviction (``SPARKNET_DEPLOY_LOG_MB``): while the
+        live shard bytes exceed the budget, delete the oldest shard
+        FILES whose records sit wholly below the trainer's consumed
+        floor — but keep their manifest entries (flagged ``evicted``),
+        so record positions never move and log-position-as-iteration
+        stays valid.  ``PackedDataset.skip(n)`` is pure index
+        arithmetic and never opens a jumped shard, so a resumed trainer
+        walks past evicted entries without touching the missing files."""
+        if self._log_budget_mb <= 0:
+            return
+        budget = int(self._log_budget_mb * (1 << 20))
+        live = sum(
+            int(s.get("bytes", 0))
+            for s in self._shards if not s.get("evicted")
+        )
+        if live <= budget:
+            return
+        floor = self._consumed_floor()
+        cum_end = 0  # records through the end of this manifest entry
+        for s in self._shards:
+            cum_end += int(s.get("records", 0))
+            if live <= budget:
+                break
+            if s.get("evicted"):
+                continue
+            if cum_end > floor:
+                break  # manifest order == age: nothing older remains
+            try:
+                os.remove(os.path.join(self.out_dir, s["file"]))
+            except FileNotFoundError:
+                pass
+            except OSError:
+                break  # disk saying no again; retry at the next seal
+            s["evicted"] = True
+            live -= int(s.get("bytes", 0))
+            self._c_evict.inc()
 
     # ------------------------------------------------- control
 
@@ -300,7 +433,10 @@ class TeeWriter:
         finished, manifested shard (tests + controlled shutdown)."""
         self._drain()
         with self._io_lock:
-            self._seal_shard()
+            try:
+                self._seal_shard()
+            except OSError as e:
+                self._io_pause(e)
 
     def stop(self) -> None:
         self._stop.set()
@@ -314,5 +450,10 @@ class TeeWriter:
             "written": self.written,
             "buffered": len(self._buf),
             "shards": len(self._shards),
+            "evicted": sum(1 for s in self._shards if s.get("evicted")),
+            "io_paused": bool(
+                self._paused_until
+                and time.monotonic() < self._paused_until
+            ),
             "capacity": self.capacity,
         }

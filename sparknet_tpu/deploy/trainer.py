@@ -23,7 +23,7 @@ import os
 import time
 from typing import Any, Dict, Optional
 
-from .tee import recover_log
+from .tee import CONSUMED_NAME, recover_log
 
 DEFAULT_SOLVER_TXT = (
     "base_lr: {lr} momentum: 0.9 lr_policy: 'fixed' display: 0 "
@@ -137,8 +137,33 @@ class IncrementalTrainer:
         solver.step(it, head - solver.iter)
         getattr(it, "close", lambda: None)()
         path = self.snapshot_prefix + f"_iter_{solver.iter}{NPZ_SUFFIX}"
-        solver.save(path)
+        # disk-full degrades to skip-with-counter: training continues
+        # and the NEXT head advance emits a candidate carrying this
+        # learning; no candidate is better than a torn one
+        if not solver.save_or_skip(path, prefix=self.snapshot_prefix):
+            return None
+        self._publish_consumed()
         return path
+
+    def _publish_consumed(self) -> None:
+        """Advertise the durable resume floor (records consumed as of
+        the newest saved solverstate) into the log dir, best-effort —
+        the tee's bounded-log retention (SPARKNET_DEPLOY_LOG_MB) only
+        evicts shards wholly below this floor, so a restart can always
+        skip() back to its resume point without touching them."""
+        from ..utils import safeio
+
+        if self._solver is None:
+            return
+        safeio.best_effort_write_json(
+            os.path.join(self.log_dir, CONSUMED_NAME),
+            {
+                "records": int(self._solver.iter) * self.batch_size,
+                "pid": os.getpid(),
+                "t": time.time(),
+            },
+            site="records",
+        )
 
     def follow(
         self,

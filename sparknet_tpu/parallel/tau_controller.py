@@ -238,10 +238,14 @@ class TauController:
         if not snapshot_prefix:
             return None
         path = f"{snapshot_prefix}_tau_controller.json"
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.snapshot(), f, indent=1)
-        os.replace(tmp, path)
+        # best-effort (safeio): the decision record is observability,
+        # not state — a full disk must not fail the training run
+        from ..utils import safeio
+
+        if not safeio.best_effort_write_json(
+            path, self.snapshot(), site="records", fsync=False
+        ):
+            return None
         return path
 
 

@@ -34,7 +34,25 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
 from typing import Any, Dict, Optional
+
+# storage-fault degradation (docs/ROBUSTNESS.md): a cache whose
+# directory cannot be created/read is disabled for the rest of the
+# process — replicas recompile (slower warmup) instead of crashing.
+# Module-global because jax's cache config is process-global too.
+_io_disabled = False
+
+
+def io_disabled() -> bool:
+    """Whether the persistent cache was disabled by a storage fault."""
+    return _io_disabled
+
+
+def _reset_io_disabled() -> None:
+    """Test hook: re-arm the cache after a fault-injection test."""
+    global _io_disabled
+    _io_disabled = False
 
 
 def net_fingerprint(
@@ -108,7 +126,7 @@ def enable_persistent_cache(
     root: str,
     fingerprint: Optional[str] = None,
     min_compile_time_s: Optional[float] = None,
-) -> Dict[str, Any]:
+) -> Optional[Dict[str, Any]]:
     """Point jax's persistent compilation cache at
     ``root[/fingerprint]`` for THIS process.  Safe to call before or
     after backend init: this jaxlib latches cache initialization once
@@ -125,11 +143,34 @@ def enable_persistent_cache(
     round-trip the serializer safely (the known jaxlib crash is
     specific to manual-collective executables, which ``jit_manual``
     already keeps out of the cache; see tests/conftest.py and
-    parallel/comm.py)."""
+    parallel/comm.py).
+
+    Degradation: a storage fault here (cache root unwritable, disk
+    full, injected ``io.*@site=compile_cache`` chaos) disables the
+    persistent cache for the rest of the process and returns None —
+    the replica warms up by compiling, exactly as if ``--compile-cache``
+    had not been passed.  The fault is counted
+    (``io_faults{site=compile_cache}``) and warned once."""
+    global _io_disabled
+    if _io_disabled:
+        return None
     import jax
 
+    from ..utils import safeio
+
     path = os.path.join(root, fingerprint) if fingerprint else root
-    os.makedirs(path, exist_ok=True)
+    try:
+        safeio.check_faults("compile_cache")
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        safeio.count_fault("compile_cache", safeio.classify(e))
+        _io_disabled = True
+        print(
+            f"WARNING: persistent compile cache disabled for this run "
+            f"({path}): {e}",
+            file=sys.stderr, flush=True,
+        )
+        return None
     if min_compile_time_s is None:
         min_compile_time_s = float(
             os.environ.get("SPARKNET_SERVE_CACHE_FLOOR_S", "") or 0.05

@@ -33,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 
 
 def add_engine_args(ap: argparse.ArgumentParser) -> None:
@@ -216,10 +217,11 @@ def write_portfile(path: str, server, engine, cache_info) -> None:
         "quant": getattr(engine, "quant", "f32"),
         "compile_cache": cache_info,
     }
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(doc, fh)
-    os.replace(tmp, path)
+    from ..utils import safeio
+
+    safeio.atomic_write_json(
+        path, doc, site="records", indent=None, fsync=False
+    )
 
 
 def main(argv=None) -> int:
@@ -244,6 +246,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     engine, batcher, metrics, server = build_stack(args)
+    # the supervisor stops replicas with SIGTERM (supervise/pool.py);
+    # exit through serve_forever's cleanup so the deploy tee seals its
+    # in-flight shard instead of abandoning a .writing file to the
+    # next open's recover_log sweep
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     if args.portfile:
         write_portfile(args.portfile, server, engine,
                        server.compile_cache_info)

@@ -21,7 +21,8 @@ Endpoints (JSON in/out, no dependencies beyond the stdlib):
 - ``GET /healthz`` — liveness + model identity + bucket config; the
   ``status`` field degrades to ``"degraded"`` while requests are being
   shed/cancelled (deadline pressure) or while a ``queue_stall`` /
-  ``straggler`` anomaly advisory is live (the ``anomalies`` field
+  ``straggler`` / ``slo_burn`` / ``disk_pressure`` anomaly advisory is
+  live (the ``anomalies`` field
   carries the active list; telemetry/anomaly.py), so balancers can
   back off.
 - ``GET /dash`` — the zero-dependency HTML dashboard
@@ -166,7 +167,8 @@ class InferenceServer:
                     status = outer.metrics.health()
                     if status == "ok" and any(
                         a.get("kind") in (
-                            "queue_stall", "straggler", "slo_burn"
+                            "queue_stall", "straggler", "slo_burn",
+                            "disk_pressure",
                         )
                         for a in active
                     ):
@@ -712,6 +714,8 @@ class InferenceServer:
         if self._thread is not None:
             self._thread.join(10)
         self.batcher.drain()
+        if self.tee is not None:
+            self.tee.stop()  # seal the in-flight shard (no torn tail)
         self._httpd.server_close()
 
     def serve_forever(self) -> None:
@@ -726,6 +730,8 @@ class InferenceServer:
                 self._watcher.stop()
                 self._watcher = None
             self.batcher.drain()
+            if self.tee is not None:
+                self.tee.stop()  # seal the in-flight shard
             self._httpd.server_close()
 
     def client(self, timeout: float = 60.0) -> "Client":

@@ -188,17 +188,17 @@ def save_state(path: str, **trees: Any) -> None:
             k: [a.dtype.str, list(a.shape)] for k, a in arrays.items()
         },
     })
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
+    def _payload(fh):
         np.savez(
             fh, **arrays, **{_META_KEY: np.frombuffer(meta.encode(), np.uint8)}
         )
-        fh.flush()
-        os.fsync(fh.fileno())
-    if _chaos_partial_write(tmp, path):
-        return
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
+
+    from ..utils import safeio
+
+    safeio.atomic_write(
+        path, _payload, site="snapshot", fsync=True, sync_dir=True,
+        pre_publish=_chaos_partial_write,
+    )
 
 
 def _fsync_dir(dirname: str) -> None:
@@ -329,6 +329,55 @@ def prune_snapshots(prefix: str, keep: Optional[int] = None) -> List[str]:
             except OSError:
                 pass
     return removed
+
+
+def save_state_or_skip(path: str, prefix: str = "", **trees: Any) -> bool:
+    """:func:`save_state` with the ENOSPC degradation policy
+    (docs/ROBUSTNESS.md "Storage faults"): on a disk-full failure,
+    prune the snapshot chain one deeper than ``SPARKNET_SNAPSHOT_KEEP``
+    normally allows and retry ONCE; any remaining failure skips the
+    snapshot — counted in ``snapshot_skipped{errno=}`` — and lets
+    training continue.  Recoverability degrades (the resume point ages)
+    but correctness never does: the prior chain is untouched and
+    :func:`restore_with_fallback` still resumes bit-exactly from it.
+
+    Returns True when the snapshot landed, False when it was skipped.
+    The prune+retry leg is single-host only: a multi-host retry would
+    re-enter the collective leaf gather on the primary alone and
+    deadlock the fabric, so multi-host runs go straight to skip.
+    """
+    from ..telemetry.registry import REGISTRY
+    from ..utils import safeio
+
+    try:
+        save_state(path, **trees)
+        return True
+    except OSError as e:
+        kind = safeio.classify(e)
+        if kind == "enospc" and prefix:
+            import jax
+
+            if jax.process_count() == 1:
+                keep = int(
+                    os.environ.get("SPARKNET_SNAPSHOT_KEEP", "8") or 0
+                )
+                pruned = prune_snapshots(prefix, keep=max(1, keep - 1))
+                if pruned:
+                    try:
+                        save_state(path, **trees)
+                        from .. import chaos
+
+                        chaos.record_recovery("snapshot.enospc_prune")
+                        return True
+                    except OSError as e2:
+                        e, kind = e2, safeio.classify(e2)
+        REGISTRY.counter("snapshot_skipped", errno=kind).inc()
+        print(
+            f"WARNING: snapshot {path} skipped ({kind}: {e}); training "
+            f"continues — resume point stays at the previous snapshot",
+            file=sys.stderr, flush=True,
+        )
+        return False
 
 
 def restore_with_fallback(

@@ -447,8 +447,21 @@ class Solver:
         (Caffe's ``.solverstate``, SURVEY.md §5)."""
         from . import snapshot
 
-        snapshot.save_state(
-            path,
+        snapshot.save_state(path, **self._snapshot_trees())
+
+    def save_or_skip(self, path: str, prefix: str = "") -> bool:
+        """:meth:`save` with the disk-full degradation policy
+        (:func:`snapshot.save_state_or_skip`): on ENOSPC prune the
+        chain one deeper and retry once, else skip with a counter and
+        keep training.  Returns True when the snapshot landed."""
+        from . import snapshot
+
+        return snapshot.save_state_or_skip(
+            path, prefix=prefix, **self._snapshot_trees()
+        )
+
+    def _snapshot_trees(self) -> dict:
+        return dict(
             params=self.params,
             state=self.state,
             opt_state=self.opt_state,

@@ -132,10 +132,14 @@ def write_failure_record(
             f"failure-g{generation}-p{process_id}-{os.getpid()}-"
             f"{time.monotonic_ns()}.json",
         )
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(record, fh, indent=1)
-        os.replace(tmp, path)  # readers never see a half-written record
+        # atomic (readers never see a half-written record) and strictly
+        # best-effort: a full disk must not raise into the dying path
+        from ..utils import safeio
+
+        if not safeio.best_effort_write_json(
+            path, record, site="records", fsync=False
+        ):
+            return None
         return path
     except Exception:
         return None
@@ -144,15 +148,42 @@ def write_failure_record(
 def write_crash_record(exc: BaseException) -> Optional[str]:
     """The apps' top-level crash path: record an uncaught exception
     before it unwinds the process.  Clean ``SystemExit(0)`` is not a
-    crash; everything else is."""
+    crash; everything else is.  An OSError anywhere in the exception
+    chain that classifies as disk-full/media-error stamps the record
+    with ``io_errno`` — the supervisor's signal to hold-and-poll for
+    space instead of burning restart budget on an environmental
+    failure (docs/ROBUSTNESS.md "Storage faults")."""
     if isinstance(exc, SystemExit) and exc.code in (0, None):
         return None
+    extra: Dict[str, Any] = {}
+    io_kind = _io_classification(exc)
+    if io_kind is not None:
+        extra["io_errno"] = io_kind
     return write_failure_record(
         process_id=_env_process_id(),
         kind="exception",
         reason=f"{type(exc).__name__}: {exc}",
         exit_code=exc.code if isinstance(exc, SystemExit) else None,
+        extra=extra or None,
     )
+
+
+def _io_classification(exc: BaseException) -> Optional[str]:
+    """Walk the exception chain (cause/context, bounded) for an
+    OSError that classifies as a storage fault; jax-free by design, so
+    the classification itself comes from utils.safeio lazily."""
+    from ..utils.safeio import classify
+
+    seen = 0
+    cur: Optional[BaseException] = exc
+    while cur is not None and seen < 16:
+        if isinstance(cur, OSError):
+            kind = classify(cur)
+            if kind in ("enospc", "eio"):
+                return kind
+        cur = cur.__cause__ or cur.__context__
+        seen += 1
+    return None
 
 
 def _env_process_id() -> int:

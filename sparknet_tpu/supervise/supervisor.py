@@ -43,7 +43,6 @@ supervisor around its one local rank (``scripts/launch_multihost.sh``).
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import signal
 import subprocess
@@ -381,12 +380,49 @@ class Supervisor:
 
     def _write_report(self) -> str:
         path = os.path.join(self.run_dir, REPORT_NAME)
-        os.makedirs(self.run_dir, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self.report, fh, indent=1)
-        os.replace(tmp, path)
+        try:
+            os.makedirs(self.run_dir, exist_ok=True)
+        except OSError:
+            pass
+        # atomic + best-effort (safeio): losing the report to a full
+        # disk must not take down the supervisor itself
+        from ..utils import safeio
+
+        safeio.best_effort_write_json(
+            path, self.report, site="records", fsync=False
+        )
         return path
+
+    def _hold_for_space(self) -> float:
+        """An io-classified child death (ENOSPC/EIO stamped in its
+        failure record) is environmental: restarting into a full disk
+        burns restart budget into a flap give-up without fixing
+        anything.  Poll the run dir's volume until free space clears
+        ``SPARKNET_DISK_HOLD_FREE_MB`` (or ``SPARKNET_DISK_HOLD_MAX_S``
+        expires), feeding the disk-pressure advisory each look; the
+        relaunch is then NOT charged to the restart policy."""
+        from ..utils import safeio
+
+        min_free = int(float(
+            os.environ.get("SPARKNET_DISK_HOLD_FREE_MB", "16") or 0
+        ) * (1 << 20))
+        poll_s = max(0.05, float(
+            os.environ.get("SPARKNET_DISK_POLL_S", "1") or 1
+        ))
+        max_s = float(
+            os.environ.get("SPARKNET_DISK_HOLD_MAX_S", "300") or 0
+        )
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < max_s:
+            free = safeio.observe_free(self.run_dir)
+            if free is None or free >= min_free:
+                break
+            _log(
+                f"disk pressure: {free / (1 << 20):.0f} MB free < "
+                f"{min_free / (1 << 20):.0f} MB floor; holding for space"
+            )
+            time.sleep(poll_s)
+        return time.monotonic() - t0
 
     def _finish(self, status: str, code: int) -> int:
         self.report["final_status"] = status
@@ -450,6 +486,43 @@ class Supervisor:
             entry["flight_recorders"] = flights
             for path in flights:
                 _log(f"flight recorder dump: {path}")
+
+            # io-classified deaths (ENOSPC/EIO in a failure record) get
+            # their own exit class: hold-and-poll for space, relaunch
+            # WITHOUT charging the restart policy — a full disk is an
+            # environmental fault no amount of restarting fixes, and
+            # burning the budget on it turns into a flap give-up
+            io_kind = next(
+                (str(r["io_errno"]) for r in recs if r.get("io_errno")),
+                None,
+            )
+            if io_kind is not None:
+                for e in entry["exits"]:
+                    if e["class"] != CLEAN:
+                        e["class"] = f"io.{io_kind}"
+                entry["io_fault"] = io_kind
+                METRICS.inc("io_holds")
+                held = self._hold_for_space()
+                entry["io_hold_s"] = round(held, 3)
+                resume = self._verify_resume(restarts)
+                entry["resume"] = (
+                    {"iter": resume[0], "path": resume[1]}
+                    if resume else None
+                )
+                METRICS.inc("restarts")
+                restarts += 1
+                from .. import chaos
+
+                chaos.record_recovery("supervisor.io_hold")
+                _log(
+                    f"generation {generation} died on storage "
+                    f"({io_kind}); held {held:.1f}s for space — "
+                    f"relaunching (restart {restarts}, restart budget "
+                    f"uncharged)"
+                )
+                generation += 1
+                continue
+
             was_healthy = duration >= self.cfg.healthy_s
             if was_healthy:
                 policy.note_healthy_run()

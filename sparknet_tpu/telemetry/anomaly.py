@@ -27,6 +27,10 @@ Four detector families:
   scrape-driven from ``/healthz`` on replicas and the router; its
   ``slo_burn`` advisory degrades ``/healthz`` and is the signal the
   future traffic-shaped autoscaler admits/sheds on.
+- :class:`DiskPressureDetector` — free-space watermark over the
+  volumes the writers touch, fed by every ``safeio`` preflight; its
+  ``disk_pressure`` advisory degrades ``/healthz`` and backs the
+  /dash storage panel (docs/ROBUSTNESS.md "Storage faults").
 
 Every firing does three things — increments the registry counter
 ``anomalies{kind=...}``, prints one structured ``anomaly: {...}`` JSON
@@ -408,12 +412,64 @@ class QueueStallDetector:
         )
 
 
+# ---------------------------------------------------------- disk pressure
+class DiskPressureDetector:
+    """Free-space watermark advisory over the volumes the writers
+    touch (fed by every ``safeio.atomic_write`` preflight and the
+    supervisor's hold-and-poll loop): free bytes at or below the
+    watermark (``SPARKNET_DISK_WATERMARK_MB``, default 256) raises a
+    ``disk_pressure`` advisory — ``serious`` severity, which degrades
+    ``/healthz`` (serve/server.py) and lights the /dash storage panel.
+    While pressure holds the advisory is re-raised every ``refire_s``
+    so its TTL stays alive; a healthy look arms the next firing so one
+    incident logs one ``anomaly:`` line, not one per write."""
+
+    def __init__(
+        self,
+        watermark_mb: Optional[float] = None,
+        refire_s: float = 15.0,
+        ttl_s: Optional[float] = None,
+        emit=print,
+        now=time.monotonic,
+    ):
+        self.watermark_mb = (
+            watermark_mb if watermark_mb is not None
+            else _env_float("SPARKNET_DISK_WATERMARK_MB", 256.0)
+        )
+        self.refire_s = refire_s
+        self.ttl_s = float(ttl_s) if ttl_s is not None else DEFAULT_TTL_S
+        self.emit = emit
+        self._now = now
+        self._last_fire: Optional[float] = None
+
+    def observe(self, free_bytes: int, path: str = "") -> Optional[Dict[str, Any]]:
+        free_mb = float(free_bytes) / (1 << 20)
+        if free_mb > self.watermark_mb:
+            self._last_fire = None  # recovered: next breach fires anew
+            return None
+        t = self._now()
+        if self._last_fire is not None and t - self._last_fire < self.refire_s:
+            return None  # advisory already fresh
+        self._last_fire = t
+        return fire(
+            "disk_pressure",
+            key="free",
+            severity="serious",
+            ttl_s=self.ttl_s,
+            emit=self.emit,
+            free_mb=round(free_mb, 1),
+            watermark_mb=self.watermark_mb,
+            path=path,
+        )
+
+
 # -------------------------------------------- process-global consumers
 _serve_stall: Optional[QueueStallDetector] = None
 _pipeline_stall: Optional[QueueStallDetector] = None
 _step_spike: Optional[EmaMadDetector] = None
 _loss_spike: Optional[EmaMadDetector] = None
 _slo_burn: Optional[SloBurnRateDetector] = None
+_disk_pressure: Optional[DiskPressureDetector] = None
 
 
 def observe_slo(latency) -> None:
@@ -466,6 +522,19 @@ def observe_pipeline(snapshot: Dict[str, Any]) -> None:
     _pipeline_stall.observe(depth, progress)
 
 
+def observe_disk(free_bytes: int, path: str = "") -> None:
+    """Write-driven disk pressure check: every ``safeio`` preflight
+    (and the supervisor's space poll) reports the volume's free bytes
+    here.  Zero-cost while the disk is healthy."""
+    global _disk_pressure
+    if _disk_pressure is None:
+        _disk_pressure = DiskPressureDetector()
+    try:
+        _disk_pressure.observe(int(free_bytes), path=path)
+    except (TypeError, ValueError):
+        return
+
+
 def observe_step(seconds: float) -> None:
     """Step-time spike stream (the train loop's display boundary)."""
     global _step_spike
@@ -485,6 +554,7 @@ def observe_loss(loss: float) -> None:
 def reset_detectors() -> None:
     """Fresh process-global detectors (test isolation)."""
     global _serve_stall, _pipeline_stall, _step_spike, _loss_spike
-    global _slo_burn
+    global _slo_burn, _disk_pressure
     _serve_stall = _pipeline_stall = _step_spike = _loss_spike = None
     _slo_burn = None
+    _disk_pressure = None

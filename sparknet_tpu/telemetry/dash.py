@@ -461,6 +461,59 @@ def _reqtrace_section(records: List[dict]) -> str:
     )
 
 
+def _storage_section(registry_snapshot: Dict[str, Any]) -> str:
+    """Storage panel (ISSUE 19): disk headroom plus every degradation
+    the writers took — ``io_faults{site=,errno=}``, skipped snapshots,
+    tee shard evictions — so an operator sees a disk-pressure incident
+    as counted policy, not as mystery stderr.  Rendered only once any
+    of those signals exists (a healthy run keeps its dashboard clean).
+    """
+    metrics = registry_snapshot.get("metrics") or {}
+    faults = metrics.get("io_faults") or {}
+    skipped = metrics.get("snapshot_skipped") or {}
+    free = (metrics.get("disk_free_bytes") or {}).get("") or {}
+    evicted = (metrics.get("deploy_tee") or {}).get("event=evict_shard", 0)
+    # the supervisor's counters ride its own registry source
+    holds = (registry_snapshot.get("supervisor") or {}).get("io_holds", 0)
+    n_faults = sum(int(v) for v in faults.values())
+    n_skipped = sum(int(v) for v in skipped.values())
+    if not (faults or skipped or evicted or holds or free):
+        return ""
+    free_v = free.get("value")
+    tiles = [
+        _tile("disk free",
+              f"{free_v / 1e9:.2f} GB" if free_v is not None else "—",
+              "last writer observation"),
+        _tile("io faults", str(n_faults),
+              f"{len(faults)} site/errno pairs"),
+        _tile("snapshots skipped", str(n_skipped),
+              "resume falls back one snapshot"),
+        _tile("tee shards evicted", str(int(evicted)),
+              "retention below consumed floor"),
+        _tile("supervisor holds", str(int(holds)),
+              "waited for space, not restart budget"),
+    ]
+    rows = []
+    for label in sorted(faults):
+        # "errno=enospc,site=tee" -> {"errno": ..., "site": ...}
+        kv = dict(p.split("=", 1) for p in label.split(",") if "=" in p)
+        rows.append(
+            f'<tr><td>{_esc(kv.get("site", "?"))}</td>'
+            f'<td>{_esc(kv.get("errno", "?"))}</td>'
+            f"<td>{int(faults[label])}</td></tr>"
+        )
+    table = (
+        '<table class="data"><thead><tr><th>site</th><th>errno</th>'
+        f'<th>faults</th></tr></thead><tbody>{"".join(rows)}</tbody>'
+        "</table>"
+    ) if rows else ""
+    return (
+        '<section><h2>Storage <span class="muted">'
+        "(writer degradations; docs/ROBUSTNESS.md)</span></h2>"
+        f'<div class="tiles">{"".join(tiles)}</div>{table}</section>'
+    )
+
+
 def _anomaly_feed(events: List[dict]) -> str:
     if not events:
         return '<p class="muted">no anomalies recorded</p>'
@@ -575,6 +628,7 @@ def render_html(
 {_deploy_section(router.get("deploy")) if router and router.get("deploy") else ''}
 {_session_section(session, decode) if session else ''}
 {_reqtrace_section(reqtrace) if reqtrace else ''}
+{_storage_section(registry_snapshot)}
 <section><h2>Serving</h2><div class="tiles">{''.join(tiles)}</div></section>
 <section><h2>Latency SLO <span class="muted">(p99 budget {budget:g} ms)</span></h2>
 <div class="tiles">{''.join(slo_tiles)}</div></section>

@@ -163,10 +163,14 @@ def dump(directory: str, tag: str = "") -> Optional[str]:
             n = _dumped
         name = f"flight-{tag + '-' if tag else ''}{os.getpid()}-{n}.json"
         path = os.path.join(directory, name)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(snapshot(), fh, indent=1, default=str)
-        os.replace(tmp, path)  # a postmortem never reads a torn dump
+        # atomic (a postmortem never reads a torn dump) + best-effort
+        # via safeio: the recorder is ALWAYS on a dying path
+        from ..utils import safeio
+
+        if not safeio.best_effort_write_json(
+            path, snapshot(), site="flight", default=str, fsync=False
+        ):
+            return None
         return path
     except Exception:
         return None

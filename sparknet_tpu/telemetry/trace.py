@@ -307,10 +307,15 @@ def flush_sidecar() -> Optional[str]:
     if not (_enabled and _role == "sidecar" and _path):
         return None
     out = part_path(_path)
-    tmp = out + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(_meta_events(events()) + events(), fh)
-    os.replace(tmp, out)  # atomic: the owner never reads a torn part
+    # atomic via safeio (the owner never reads a torn part); a full
+    # disk drops the sidecar's trace, never the sidecar
+    from ..utils import safeio
+
+    if not safeio.best_effort_write_json(
+        out, _meta_events(events()) + events(),
+        site="flight", indent=None, fsync=False,
+    ):
+        return None
     return out
 
 
@@ -342,10 +347,11 @@ def write(path: Optional[str] = None) -> Optional[str]:
             continue
     evts.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
     doc = {"traceEvents": evts, "displayTimeUnit": "ms"}
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(doc, fh)
-    os.replace(tmp, path)
+    from ..utils import safeio
+
+    safeio.atomic_write_json(
+        path, doc, site="flight", indent=None, fsync=False
+    )
     return path
 
 

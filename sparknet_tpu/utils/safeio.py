@@ -1,0 +1,311 @@
+"""safeio: the one atomic-write helper under every persistent byte.
+
+Before this module, ten call sites (solver/snapshot, deploy/tee,
+deploy/gate, data/records, supervise/records, supervise/supervisor,
+telemetry/flight, telemetry/trace, serve/replica,
+parallel/tau_controller) each hand-rolled the same tmp + flush +
+fsync + ``os.replace`` dance — and none of them had an answer for the
+disk itself saying no.  :func:`atomic_write` unifies the dance and
+adds the storage-fault layer (docs/ROBUSTNESS.md "Storage faults"):
+
+- **pid-unique staging** (``<path>.<pid>.tmp``) so concurrent writers
+  on one target never clobber each other's tmp (PR 18's manifest fix,
+  now the default for every writer);
+- **chaos injection** via the ``io.*`` fault points, targetable by
+  writer *site tag* (``snapshot``, ``tee``, ``cache``,
+  ``compile_cache``, ``records``, ``flight``, ``ledger``) — see
+  :func:`check_faults`;
+- **errno classification** (:func:`classify`: ENOSPC/EDQUOT →
+  ``enospc``, EIO → ``eio``, rest → ``os_error``) feeding the
+  ``io_faults{site=,errno=}`` counters, so degradation policies can
+  branch on *what kind* of no the disk said;
+- **free-space preflight**: every write observes the volume's free
+  bytes (``disk_free_bytes`` gauge + DiskPressureDetector advisory),
+  and optionally refuses early below ``SPARKNET_DISK_MIN_FREE_MB``.
+
+The helper raises plain :class:`OSError` — callers own the
+degradation policy (skip, retry, pause, disable); this module only
+guarantees the target file is either the old bytes or the new bytes,
+never a torn hybrid, and that every failure is counted.
+
+Env knobs: ``SPARKNET_DISK_MIN_FREE_MB`` (default 0 = observe-only
+preflight), ``SPARKNET_DISK_WATERMARK_MB`` (advisory threshold, see
+telemetry/anomaly.py).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Union
+
+# the writer site tags io.* chaos points target (docs/ROBUSTNESS.md
+# storage-fault catalog); check_faults accepts any tag, these are the
+# ones wired today
+SITES = (
+    "snapshot", "tee", "cache", "compile_cache", "records", "flight",
+    "ledger",
+)
+
+_ENOSPC_ERRNOS = {errno.ENOSPC, getattr(errno, "EDQUOT", errno.ENOSPC)}
+
+_lock = threading.Lock()
+_site_seq: Dict[str, int] = {}   # per-site write counter (chaos index)
+_storm_until = 0.0               # monotonic deadline; 0.0 = no storm
+
+
+def reset() -> None:
+    """Test isolation: zero the per-site chaos sequence counters and
+    clear any active ENOSPC storm."""
+    global _storm_until
+    with _lock:
+        _site_seq.clear()
+        _storm_until = 0.0
+
+
+def classify(err: BaseException) -> str:
+    """Map an exception to a storage-fault class: ``enospc`` (disk
+    full / quota), ``eio`` (media error), else ``os_error``."""
+    eno = getattr(err, "errno", None)
+    if eno in _ENOSPC_ERRNOS:
+        return "enospc"
+    if eno == errno.EIO:
+        return "eio"
+    return "os_error"
+
+
+def count_fault(site: str, kind: str) -> None:
+    """One ``io_faults{site=,errno=}`` tick (real and injected faults
+    alike — the counter is how degradation stays observable)."""
+    from ..telemetry.registry import REGISTRY
+
+    REGISTRY.counter("io_faults", site=site, errno=kind).inc()
+
+
+def free_bytes(path: str) -> Optional[int]:
+    """Free bytes on the volume holding ``path`` (walks up to the
+    nearest existing directory); None when even that is unstatable."""
+    p = os.path.abspath(path or ".")
+    while p and not os.path.isdir(p):
+        parent = os.path.dirname(p)
+        if parent == p:
+            break
+        p = parent
+    try:
+        st = os.statvfs(p)
+    except OSError:
+        return None
+    return int(st.f_bavail) * int(st.f_frsize)
+
+
+def observe_free(path: str) -> Optional[int]:
+    """Publish the volume's free bytes: ``disk_free_bytes`` gauge +
+    the disk-pressure anomaly detector.  Returns the free bytes."""
+    free = free_bytes(path)
+    if free is None:
+        return None
+    try:
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.gauge("disk_free_bytes").set(float(free))
+        from ..telemetry.anomaly import observe_disk
+
+        observe_disk(free, path=path)
+    except Exception:
+        pass  # observability must never fail the write path
+    return free
+
+
+def storm_active() -> bool:
+    return _storm_until > 0.0 and time.monotonic() < _storm_until
+
+
+def _next_index(site: str) -> int:
+    with _lock:
+        i = _site_seq.get(site, 0)
+        _site_seq[site] = i + 1
+        return i
+
+
+def check_faults(site: str) -> None:
+    """Chaos injection for a writer site — raises OSError(ENOSPC/EIO)
+    or sleeps per the installed plan's ``io.*`` rules.  Standalone
+    entry point for writers that don't stage files through
+    :func:`atomic_write` (shm cache segments, shard streams).
+
+    An ``io.enospc_storm`` match opens a volume-wide disk-full window:
+    every site's writes fail ENOSPC until ``clear_after_s`` elapses —
+    the realistic shape of a full volume, and what forces pause/resume
+    (tee) and hold-and-poll (supervisor) policies to actually engage.
+    Storm failures raise here but are NOT re-counted in chaos METRICS
+    (the rule fired once); they still land in ``io_faults``.
+    """
+    global _storm_until
+    now = time.monotonic()
+    if _storm_until > 0.0:
+        if now < _storm_until:
+            raise OSError(
+                errno.ENOSPC,
+                f"chaos: enospc storm at site={site} "
+                f"({_storm_until - now:.1f}s to clear)",
+            )
+        with _lock:
+            if _storm_until > 0.0 and now >= _storm_until:
+                _storm_until = 0.0
+                from .. import chaos
+
+                chaos.record_recovery("io.storm_cleared")
+    from .. import chaos
+
+    plan = chaos.get_plan()
+    if plan is None:
+        return
+    idx = _next_index(site)
+    rule = plan.match("io.slow_write", site=site, index=idx)
+    if rule is not None:
+        time.sleep(float(rule.params.get("delay_ms", 50)) / 1000.0)
+    rule = plan.match("io.enospc_storm", site=site, index=idx)
+    if rule is not None:
+        with _lock:
+            _storm_until = time.monotonic() + float(
+                rule.params.get("clear_after_s", 2)
+            )
+        raise OSError(
+            errno.ENOSPC, f"chaos: enospc storm opened at site={site}"
+        )
+    if plan.fires("io.enospc", site=site, index=idx):
+        raise OSError(errno.ENOSPC, f"chaos: injected ENOSPC at {site}")
+    if plan.fires("io.eio", site=site, index=idx):
+        raise OSError(errno.EIO, f"chaos: injected EIO at {site}")
+
+
+def _min_free_bytes() -> int:
+    try:
+        mb = float(os.environ.get("SPARKNET_DISK_MIN_FREE_MB", "0") or 0)
+    except ValueError:
+        mb = 0.0
+    return int(mb * (1 << 20))
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the parent directory so the rename itself is durable
+    (POSIX leaves directory-entry durability to the caller)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # not supported here (some filesystems) — best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(
+    path: str,
+    payload: Union[bytes, str, Callable],
+    *,
+    site: str,
+    fsync: bool = True,
+    sync_dir: bool = False,
+    binary: Optional[bool] = None,
+    tmp: Optional[str] = None,
+    pre_publish: Optional[Callable[[str, str], bool]] = None,
+) -> str:
+    """Atomically publish ``payload`` at ``path``: stage to a
+    pid-unique tmp, flush (+fsync), ``os.replace``.  The target is
+    only ever the old bytes or the complete new bytes.
+
+    ``payload`` may be bytes, str, or a callable taking the open file
+    handle (``binary`` picks the mode for callables, default True).
+    ``site`` is the writer tag for chaos targeting and the
+    ``io_faults`` counter.  ``pre_publish(tmp, path)`` runs between
+    staging and rename; returning True means it already published
+    (the snapshot torn-write chaos hook) and the rename is skipped.
+
+    On OSError the tmp is unlinked best-effort, the fault is counted
+    (``io_faults{site=,errno=}``), and the error re-raises — the
+    caller owns the degradation policy.
+    """
+    try:
+        check_faults(site)
+    except OSError as e:
+        count_fault(site, classify(e))
+        raise
+    free = observe_free(path)
+    min_free = _min_free_bytes()
+    if min_free > 0 and free is not None and free < min_free:
+        count_fault(site, "enospc")
+        raise OSError(
+            errno.ENOSPC,
+            f"safeio preflight: {free} free bytes < "
+            f"SPARKNET_DISK_MIN_FREE_MB floor at site={site}",
+        )
+    if tmp is None:
+        tmp = f"{path}.{os.getpid()}.tmp"
+    if binary is None:
+        binary = not isinstance(payload, str)
+    mode = "wb" if binary else "w"
+    try:
+        with open(tmp, mode) as fh:
+            if callable(payload):
+                payload(fh)
+            else:
+                fh.write(payload)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        if pre_publish is not None and pre_publish(tmp, path):
+            return path
+        os.replace(tmp, path)
+        if sync_dir:
+            _fsync_dir(path)
+    except OSError as e:
+        count_fault(site, classify(e))
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def atomic_write_json(
+    path: str,
+    doc,
+    *,
+    site: str,
+    indent: Optional[int] = 1,
+    default=None,
+    fsync: bool = True,
+    sync_dir: bool = False,
+) -> str:
+    """JSON convenience wrapper over :func:`atomic_write` (the shape
+    most of the ten migrated writers had)."""
+    return atomic_write(
+        path,
+        json.dumps(doc, indent=indent, default=default),
+        site=site,
+        fsync=fsync,
+        sync_dir=sync_dir,
+        binary=False,
+    )
+
+
+def best_effort_write_json(path: str, doc, *, site: str, **kw) -> bool:
+    """The strictly-best-effort flavor (flight recorders, failure
+    records, verdict drops): never raises — a full disk must not take
+    down the path that is already crashing.  Returns False (counted)
+    on failure."""
+    try:
+        atomic_write_json(path, doc, site=site, **kw)
+        return True
+    except OSError:
+        return False
+    except Exception:
+        # json encode errors etc. — still never raise
+        count_fault(site, "os_error")
+        return False

@@ -69,7 +69,7 @@ import pytest
 
 
 @pytest.fixture(autouse=True, scope="session")
-def assert_no_pipeline_leaks():
+def assert_no_pipeline_leaks(tmp_path_factory):
     """Tier-1 runs on CPU and must stay leak-free: after the whole
     session, no input-pipeline worker process may still be alive — the
     originals AND the chaos-era *respawned* replacements (named
@@ -107,3 +107,20 @@ def assert_no_pipeline_leaks():
             f"decoded-batch cache segments leaked past tests (a test "
             f"opened a cache namespace without clear()): {cache_segs}"
         )
+    # storage-fault hygiene (utils/safeio.py): every atomic writer
+    # must either publish (rename) or unlink its staging file, even
+    # under injected ENOSPC/EIO, and an abandoned tee shard must be
+    # renamed ``.writing.quarantined`` — so NO bare ``*.tmp*`` or
+    # ``*.writing`` file may survive the suite anywhere under pytest's
+    # session temp root.
+    base = str(tmp_path_factory.getbasetemp())
+    stale = []
+    for root, _dirs, files in os.walk(base):
+        for name in files:
+            if name.endswith(".writing") or ".tmp" in name:
+                stale.append(os.path.join(root, name))
+    assert not stale, (
+        f"staging files leaked past tests (a writer failed without "
+        f"cleaning up its tmp, or a torn tee shard was not "
+        f"quarantined): {stale[:20]}"
+    )

@@ -1,0 +1,440 @@
+"""Storage-fault hardening (ISSUE 19): deterministic disk-fault
+injection through ``utils/safeio`` and the per-writer degradation
+policies (docs/ROBUSTNESS.md "Storage faults").
+
+The contract under test: a disk that says no (ENOSPC / EIO, injected
+via the ``io.*`` chaos points) never tears a published file, never
+takes down a serving or training process, and every degradation a
+writer takes is a counted policy — skipped snapshots, paused tees,
+evicted cache segments, disabled compile caches.
+"""
+
+import errno
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import chaos
+from sparknet_tpu.chaos.plan import FAULT_POINTS, FaultPlan
+from sparknet_tpu.solver import snapshot
+from sparknet_tpu.telemetry import anomaly
+from sparknet_tpu.telemetry.registry import REGISTRY
+from sparknet_tpu.utils import safeio
+
+IO_POINTS = ("io.enospc", "io.eio", "io.slow_write", "io.enospc_storm")
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """No chaos plan, io-site sequence, storm window, or detector state
+    may leak between tests."""
+    chaos.clear()
+    safeio.reset()
+    anomaly.reset_detectors()
+    yield
+    chaos.clear()
+    safeio.reset()
+    anomaly.reset_detectors()
+
+
+def _fault_count(site, kind):
+    snap = REGISTRY.snapshot().get("metrics", {}).get("io_faults") or {}
+    return snap.get(f"errno={kind},site={site}", 0)
+
+
+# ------------------------------------------------------------- grammar
+def test_io_fault_points_registered_and_parse_bare():
+    for point in IO_POINTS:
+        assert point in FAULT_POINTS
+        assert FaultPlan(point).points() == [point]
+
+
+def test_site_is_a_string_coordinate():
+    p = FaultPlan("io.enospc@site=tee:index=0")
+    assert p.match("io.enospc", site="cache", index=0) is None
+    rule = p.match("io.enospc", site="tee", index=0)
+    assert rule is not None and rule.match["site"] == "tee"
+    # site values are bare tags, not paths/globs
+    with pytest.raises(ValueError):
+        FaultPlan("io.enospc@site=../evil")
+    with pytest.raises(ValueError):
+        FaultPlan("io.enospc@site=")
+
+
+def test_storm_and_slow_write_params_parse():
+    p = FaultPlan(
+        "io.enospc_storm@times=1:clear_after_s=3,"
+        "io.slow_write@site=records:delay_ms=7"
+    )
+    storm = p.match("io.enospc_storm", site="snapshot", index=0)
+    assert storm is not None and storm.params["clear_after_s"] == 3
+    slow = p.match("io.slow_write", site="records", index=0)
+    assert slow is not None and slow.params["delay_ms"] == 7
+
+
+# ------------------------------------------------------------- safeio
+def test_atomic_write_enospc_keeps_old_bytes_and_counts(tmp_path):
+    path = str(tmp_path / "doc.json")
+    before = _fault_count("records", "enospc")
+    # the per-site write sequence is the chaos index (counted only
+    # while a plan is installed): index=1 hits exactly the SECOND
+    # records write
+    chaos.install("io.enospc@site=records:index=1")
+    safeio.atomic_write_json(path, {"v": 1}, site="records", fsync=False)
+    with pytest.raises(OSError) as ei:
+        safeio.atomic_write_json(path, {"v": 2}, site="records",
+                                 fsync=False)
+    assert ei.value.errno == errno.ENOSPC
+    with open(path) as fh:
+        assert json.load(fh) == {"v": 1}  # old bytes, never torn
+    assert not glob.glob(str(tmp_path / "*.tmp*"))  # staging cleaned
+    assert _fault_count("records", "enospc") == before + 1
+    # a different site is untouched by the site-targeted rule
+    other = str(tmp_path / "other.json")
+    safeio.atomic_write_json(other, {"ok": 1}, site="flight", fsync=False)
+    assert os.path.exists(other)
+
+
+def test_slow_write_injects_latency_not_failure(tmp_path):
+    chaos.install("io.slow_write@site=flight:delay_ms=80:times=1")
+    path = str(tmp_path / "slow.json")
+    t0 = time.monotonic()
+    safeio.atomic_write_json(path, {"v": 1}, site="flight", fsync=False)
+    assert time.monotonic() - t0 >= 0.08
+    with open(path) as fh:
+        assert json.load(fh) == {"v": 1}
+
+
+def test_enospc_storm_is_volume_wide_and_clears(tmp_path):
+    chaos.install("io.enospc_storm@times=1:clear_after_s=0.2")
+    with pytest.raises(OSError):  # the match opens the storm window
+        safeio.check_faults("snapshot")
+    assert safeio.storm_active()
+    with pytest.raises(OSError):  # ...which blocks EVERY site
+        safeio.check_faults("tee")
+    with pytest.raises(OSError):
+        safeio.check_faults("cache")
+    time.sleep(0.25)
+    safeio.check_faults("records")  # storm expired: writes flow again
+    assert not safeio.storm_active()
+    assert chaos.METRICS.recovery_count("io.storm_cleared") == 1
+
+
+def test_preflight_floor_refuses_early(tmp_path, monkeypatch):
+    # an absurd floor (1 PB) always trips: the write is refused BEFORE
+    # any bytes are staged
+    monkeypatch.setenv("SPARKNET_DISK_MIN_FREE_MB", str(1 << 30))
+    path = str(tmp_path / "doc.json")
+    with pytest.raises(OSError) as ei:
+        safeio.atomic_write_json(path, {"v": 1}, site="records",
+                                 fsync=False)
+    assert ei.value.errno == errno.ENOSPC
+    assert not os.path.exists(path)
+    assert not glob.glob(str(tmp_path / "*.tmp*"))
+
+
+def test_best_effort_writer_never_raises(tmp_path):
+    chaos.install("io.eio@site=flight")
+    path = str(tmp_path / "fl.json")
+    assert not safeio.best_effort_write_json(
+        path, {"v": 1}, site="flight", fsync=False
+    )
+    assert not os.path.exists(path)
+    chaos.clear()
+    assert safeio.best_effort_write_json(
+        path, {"v": 1}, site="flight", fsync=False
+    )
+    with open(path) as fh:
+        assert json.load(fh) == {"v": 1}
+
+
+# --------------------------------------------------------- disk pressure
+def test_disk_pressure_detector_fires_below_watermark():
+    seen = []
+    det = anomaly.DiskPressureDetector(
+        watermark_mb=10, refire_s=100.0, emit=seen.append
+    )
+    assert det.observe(64 << 20) is None  # headroom: quiet
+    ev = det.observe(5 << 20)
+    assert ev is not None and ev["kind"] == "disk_pressure"
+    assert ev["severity"] == "serious"
+    assert det.observe(5 << 20) is None  # rate-limited while fresh
+    assert det.observe(64 << 20) is None  # recovery re-arms...
+    assert det.observe(5 << 20) is not None  # ...the next breach
+    assert len(seen) == 2
+    assert any(a["kind"] == "disk_pressure" for a in anomaly.active())
+
+
+# -------------------------------------------------------------- snapshot
+def test_enospc_snapshot_skip_parity_and_fallback(tmp_path):
+    import jax
+
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.trainer import Solver
+
+    net_txt = """
+name: "tiny"
+layer { name: "data" type: "Input" top: "data" }
+layer { name: "label" type: "Input" top: "label" }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+        inner_product_param { num_output: 3
+          weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+"""
+    sp_txt = 'base_lr: 0.1\nlr_policy: "fixed"\nmomentum: 0.9\nmax_iter: 8\n'
+
+    def make_solver():
+        sp = caffe_pb.load_solver(sp_txt, is_path=False)
+        sp.net_param = caffe_pb.load_net(net_txt, is_path=False)
+        return Solver(sp, {"data": (8, 6), "label": (8,)})
+
+    def assert_trees_equal(a, b):
+        la = jax.tree_util.tree_leaves_with_path(a)
+        lb = jax.tree_util.tree_leaves_with_path(b)
+        assert len(la) == len(lb)
+        for (pa, xa), (pb, xb) in zip(la, lb):
+            assert pa == pb
+            np.testing.assert_array_equal(
+                np.asarray(xa), np.asarray(xb), err_msg=str(pa)
+            )
+
+    rng = np.random.default_rng(5)
+    batches = [
+        {
+            "data": rng.normal(size=(8, 6)).astype(np.float32),
+            "label": rng.integers(0, 3, 8).astype(np.int32),
+        }
+        for _ in range(4)
+    ]
+    prefix = str(tmp_path / "run")
+
+    # reference run: the mid-run snapshot lands normally
+    s1 = make_solver()
+    s1.step(iter(batches[:2]), 2)
+    assert s1.save_or_skip(f"{prefix}_iter_2.solverstate.npz", prefix)
+    s1.step(iter(batches[2:]), 2)
+
+    # degraded run: the same snapshot hits a full disk and is skipped —
+    # chain intact (nothing new, nothing torn), training continues
+    chaos.install("io.enospc@site=snapshot:every=1")
+    s2 = make_solver()
+    s2.step(iter(batches[:2]), 2)
+    other = str(tmp_path / "deg")
+    skipped = REGISTRY.snapshot()["metrics"].get(
+        "snapshot_skipped", {}
+    ).get("errno=enospc", 0)
+    assert not s2.save_or_skip(f"{other}_iter_2.solverstate.npz", other)
+    assert not os.path.exists(f"{other}_iter_2.solverstate.npz")
+    assert not glob.glob(str(tmp_path / "*.tmp*"))
+    assert REGISTRY.snapshot()["metrics"]["snapshot_skipped"][
+        "errno=enospc"
+    ] == skipped + 1
+    s2.step(iter(batches[2:]), 2)
+    chaos.clear()
+    safeio.reset()
+
+    # a skipped snapshot never perturbs the training trajectory
+    assert s2.iter == s1.iter
+    assert_trees_equal(s1.params, s2.params)
+    assert_trees_equal(s1.opt_state, s2.opt_state)
+
+    # a torn newest snapshot falls back to the intact chain, bit-exact
+    torn = f"{prefix}_iter_4.solverstate.npz"
+    with open(torn, "wb") as fh:
+        fh.write(b"not a solverstate")
+    s3 = make_solver()
+    restored = snapshot.restore_with_fallback(s3, prefix, torn)
+    assert restored == f"{prefix}_iter_2.solverstate.npz"
+    assert s3.iter == 2
+    s3.step(iter(batches[2:]), 2)
+    assert_trees_equal(s1.params, s3.params)
+
+
+def test_save_state_or_skip_prunes_then_retries(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARKNET_SNAPSHOT_KEEP", "2")
+    prefix = str(tmp_path / "run")
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    for it in (2, 4):
+        snapshot.save_state(
+            f"{prefix}_iter_{it}.solverstate.npz", tree=tree, it=it
+        )
+    # the first snapshot write fails ENOSPC; the policy prunes the
+    # chain one deeper than keep (2 -> 1) and the retry lands
+    chaos.install("io.enospc@site=snapshot:index=0")
+    ok = snapshot.save_state_or_skip(
+        f"{prefix}_iter_6.solverstate.npz", prefix=prefix, tree=tree, it=6
+    )
+    assert ok
+    assert os.path.exists(f"{prefix}_iter_6.solverstate.npz")
+    assert not os.path.exists(f"{prefix}_iter_2.solverstate.npz")  # pruned
+    assert os.path.exists(f"{prefix}_iter_4.solverstate.npz")
+    assert chaos.METRICS.recovery_count("snapshot.enospc_prune") == 1
+
+
+# ------------------------------------------------------------------ tee
+def test_tee_pauses_on_enospc_and_resumes(tmp_path):
+    from sparknet_tpu.deploy.tee import TeeWriter
+
+    chaos.install("io.enospc@site=tee:times=1")
+    tee = TeeWriter(str(tmp_path), interval_s=60.0, shard_records=4)
+    try:
+        for i in range(4):
+            assert tee.offer({
+                "data": np.full(4, i, np.float32),
+                "label": np.int32(i),
+            })
+        tee.flush()  # shard creation hits the injected ENOSPC
+        st = tee.stats()
+        assert st["io_paused"] and st["dropped"] == 1 and st["shards"] == 0
+        assert _fault_count("tee", "enospc") >= 1
+        time.sleep(0.3)  # the 0.25 s first backoff elapses
+        tee.flush()  # space is back: the drain seals the survivors
+    finally:
+        tee.stop()
+    st = tee.stats()
+    assert st["shards"] == 1 and st["written"] == 3
+    assert not st["io_paused"]
+    assert chaos.METRICS.recovery_count("deploy.tee_resume") == 1
+    # the published log is readable and carries exactly the survivors
+    from sparknet_tpu.data import records as rec
+
+    ds = rec.PackedDataset(str(tmp_path))
+    assert ds.num_records == 3
+    # no bare staging file survives; quarantines are allowed
+    assert not glob.glob(str(tmp_path / "*.writing"))
+
+
+def test_tee_retention_evicts_only_below_consumed_floor(
+    tmp_path, monkeypatch
+):
+    from sparknet_tpu.data import records as rec
+    from sparknet_tpu.deploy.tee import CONSUMED_NAME, TeeWriter
+
+    monkeypatch.setenv("SPARKNET_DEPLOY_LOG_MB", "0.002")  # ~2 KB budget
+    tee = TeeWriter(str(tmp_path), interval_s=60.0, shard_records=4)
+    try:
+        def seal_batch(tag):
+            for i in range(4):
+                tee.offer({
+                    "data": np.full(256, tag * 10 + i, np.float32),
+                    "label": np.int32(i),
+                })
+            tee.flush()
+
+        seal_batch(0)
+        seal_batch(1)
+        # over budget but the trainer has consumed nothing: the log
+        # must NOT shed records a resume still needs
+        assert tee.stats()["evicted"] == 0
+        # trainer publishes its durable floor: the first shard's 4
+        # records are consumed, the second shard's are not
+        with open(os.path.join(str(tmp_path), CONSUMED_NAME), "w") as fh:
+            json.dump({"records": 4}, fh)
+        seal_batch(2)
+        st = tee.stats()
+        assert st["shards"] == 3 and st["evicted"] == 1
+    finally:
+        tee.stop()
+    # the evicted shard keeps its manifest entry (positions never
+    # move) but its FILE is gone; later shards are untouched
+    with open(os.path.join(str(tmp_path), rec.MANIFEST_NAME)) as fh:
+        m = json.load(fh)
+    shards = m["shards"]
+    assert len(shards) == 3
+    assert shards[0].get("evicted") is True
+    assert not os.path.exists(os.path.join(str(tmp_path), shards[0]["file"]))
+    for s in shards[1:]:
+        assert not s.get("evicted")
+        assert os.path.exists(os.path.join(str(tmp_path), s["file"]))
+    # record positions past the evicted span are unchanged: the second
+    # shard still holds records 4..7 with their original payloads
+    ds = rec.PackedDataset(str(tmp_path))
+    assert ds.num_records == 12
+    r = rec.PackedShardReader(
+        os.path.join(str(tmp_path), shards[1]["file"])
+    )
+    try:
+        np.testing.assert_array_equal(
+            r.record(0)["data"], np.full(256, 10, np.float32)
+        )
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------- cache
+def test_shm_cache_enospc_evicts_and_retries_then_disables(tmp_path):
+    from sparknet_tpu.data.cache import ShmBatchCache
+
+    ns = f"iofault-{os.getpid()}"
+    cache = ShmBatchCache(ns, registry_dir=str(tmp_path), max_bytes=1 << 20)
+    try:
+        batch = {"x": np.arange(16, dtype=np.float32)}
+        # one injected ENOSPC: the put sheds unpinned entries and the
+        # single retry lands — callers never notice
+        assert cache.put("k0", batch)
+        chaos.install("io.enospc@site=cache:times=1")
+        assert cache.put("k1", batch)
+        assert cache.get("k1") is not None
+        assert not cache._io_disabled
+        assert _fault_count("cache", "enospc") >= 1
+        # a persistent fault (two in a row) disables puts for the run
+        chaos.install("io.enospc@site=cache:every=1")
+        assert not cache.put("k2", batch)
+        assert cache._io_disabled
+        chaos.clear()
+        assert not cache.put("k3", batch)  # still off: counted skip
+        # the emergency shed emptied the namespace before the failed
+        # retry: every get is now a clean miss (decode fallback), not
+        # an error — a dead cache costs time, never correctness
+        assert cache.get("k0") is None
+        assert cache.metrics.snapshot()["put_skipped"] >= 2
+    finally:
+        cache.clear()
+
+
+# -------------------------------------------------------- compile cache
+def test_compile_cache_disables_for_the_run(tmp_path):
+    from sparknet_tpu.serve import compile_cache
+
+    chaos.install("io.eio@site=compile_cache:times=1")
+    try:
+        assert compile_cache.enable_persistent_cache(
+            str(tmp_path / "cc"), "deadbeef"
+        ) is None
+        assert compile_cache.io_disabled()
+        chaos.clear()
+        # still disabled for the rest of the process — no flapping
+        assert compile_cache.enable_persistent_cache(
+            str(tmp_path / "cc"), "deadbeef"
+        ) is None
+        assert _fault_count("compile_cache", "eio") >= 1
+    finally:
+        compile_cache._reset_io_disabled()
+
+
+# ----------------------------------------------------------- supervisor
+def test_crash_record_classifies_io_errno(tmp_path, monkeypatch):
+    from sparknet_tpu.supervise import records as srec
+
+    monkeypatch.setenv("SPARKNET_SUPERVISE_DIR", str(tmp_path))
+    try:
+        try:
+            raise OSError(errno.ENOSPC, "disk full")
+        except OSError as inner:
+            try:
+                raise RuntimeError("snapshot failed") from inner
+            except RuntimeError as outer:
+                path = srec.write_crash_record(outer)
+    finally:
+        monkeypatch.delenv("SPARKNET_SUPERVISE_DIR")
+    assert path is not None
+    with open(path) as fh:
+        record = json.load(fh)
+    assert record["io_errno"] == "enospc"
+    # non-io crashes carry no classification
+    assert srec._io_classification(ValueError("nope")) is None
+    assert srec._io_classification(OSError(errno.EIO, "bad media")) == "eio"
